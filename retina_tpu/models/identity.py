@@ -1,0 +1,123 @@
+"""Device-resident IP -> pod-index identity map.
+
+Reference analog: pkg/enricher/enricher.go:102-135 looks up src/dst IP in
+the node-local cache (pkg/controllers/cache) per flow and attaches pod
+namespace/name/labels strings. Strings don't belong on a TPU, so identity
+is split:
+
+- host side (retina_tpu.enrich.cache): pod metadata keyed by a dense
+  **pod index**; index 0 is reserved for "unknown/world";
+- device side (this module): an open-addressed table mapping IPv4 -> pod
+  index with PROBES-slot linear probing, rebuilt by the host on pod churn
+  (a (2, S) u32 upload, e.g. 512 KB at S=2^16 — amortized over millions of
+  events per rebuild);
+- the jitted step gathers pod indices for src/dst of the whole batch —
+  the "enrichment join" as PROBES gathers + compares, no control flow.
+
+Host insert places each key in the first free of its PROBES probe slots and
+reseeds the whole table if placement fails (cuckoo-lite); at the enforced
+<=50% load factor placement virtually always succeeds on the first seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.ops.hashing import hash_cols, reduce_range
+
+PROBES = 4
+
+
+def _base_slot_np(ips: np.ndarray, n_slots: int, seed: int) -> np.ndarray:
+    """Host mirror of the device slot computation (must match lookup())."""
+    return np.asarray(
+        reduce_range(
+            hash_cols([jnp.asarray(ips, jnp.uint32)], np.uint32(0x1DE47) + np.uint32(seed)),
+            n_slots,
+        )
+    )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class IdentityMap:
+    """(S,) ip keys + (S,) pod indices; ip==0 marks an empty slot."""
+
+    ips: jnp.ndarray
+    indices: jnp.ndarray
+    seed: int = 0
+
+    def tree_flatten(self):
+        return (self.ips, self.indices), (self.seed,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(ips=children[0], indices=children[1], seed=aux[0])
+
+    @classmethod
+    def zeros(cls, n_slots: int = 1 << 16, seed: int = 0) -> "IdentityMap":
+        assert n_slots & (n_slots - 1) == 0
+        return cls(
+            ips=jnp.zeros((n_slots,), jnp.uint32),
+            indices=jnp.zeros((n_slots,), jnp.uint32),
+            seed=seed,
+        )
+
+    @property
+    def n_slots(self) -> int:
+        return int(self.ips.shape[0])
+
+    @classmethod
+    def build_host(
+        cls, ip_to_index: dict[int, int], n_slots: int = 1 << 16, seed: int = 0
+    ) -> "IdentityMap":
+        """Host-side construction from the enricher cache's ip->pod dict."""
+        items = [(ip, idx) for ip, idx in ip_to_index.items() if ip != 0]
+        if len(items) > n_slots // 2:
+            raise ValueError(
+                f"identity map overfull: {len(items)} pods into {n_slots} slots"
+            )
+        keys = np.array([ip for ip, _ in items], np.uint32)
+        vals = np.array([i for _, i in items], np.uint32)
+        for attempt in range(64):
+            s = seed + attempt
+            ips = np.zeros((n_slots,), np.uint32)
+            idxs = np.zeros((n_slots,), np.uint32)
+            if len(keys) == 0:
+                return cls(jnp.asarray(ips), jnp.asarray(idxs), seed=s)
+            base = _base_slot_np(keys, n_slots, s)
+            ok = True
+            for k, v, b in zip(keys, vals, base):
+                for p in range(PROBES):
+                    slot = (int(b) + p) & (n_slots - 1)
+                    if ips[slot] == 0:
+                        ips[slot] = k
+                        idxs[slot] = v
+                        break
+                else:
+                    ok = False
+                    break
+            if ok:
+                return cls(jnp.asarray(ips), jnp.asarray(idxs), seed=s)
+        raise RuntimeError(
+            f"could not place {len(items)} pods into {n_slots} slots "
+            f"with {PROBES}-probe chains in 64 seeds"
+        )
+
+    def lookup(self, ip: jnp.ndarray) -> jnp.ndarray:
+        """(B,) IPs -> (B,) pod indices (0 = unknown). PROBES gathers."""
+        base = reduce_range(
+            hash_cols([ip], np.uint32(0x1DE47) + np.uint32(self.seed)), self.n_slots
+        )
+        out = jnp.zeros_like(ip)
+        for p in range(PROBES):
+            slot = ((base + jnp.uint32(p)) & jnp.uint32(self.n_slots - 1)).astype(
+                jnp.int32
+            )
+            hit = self.ips[slot] == ip
+            out = jnp.where(hit, self.indices[slot], out)
+        return out

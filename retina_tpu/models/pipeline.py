@@ -1,0 +1,359 @@
+"""TelemetryPipeline: the flagship fused aggregation step.
+
+Reference analog: the enricher output ring -> Module.run loop calling every
+registered metric's ProcessFlow per flow (metrics_module.go:283-303,
+forward.go:97-171, drops.go, tcpflags.go, dns.go) — single-threaded Go, the
+system's scaling bottleneck per SURVEY.md §3.2. Here all enabled
+aggregators consume the whole batch inside ONE jit-compiled step, so XLA
+fuses hashing, masking, enrichment join, and sketch scatters into a single
+device program; HBM traffic is one pass over the (B, 16) record block plus
+the sketch tables.
+
+Cardinality design (the reference's modes, docs/03-Metrics/modes/modes.md):
+- bounded label spaces (pod x direction, pod x reason, pod x flag) use
+  **dense exact counter rectangles** — TPU-friendly scatter-adds, zero
+  approximation, bounded memory (the "local context" mode);
+- unbounded label spaces (5-tuples, pod-pairs, DNS queries) use **sketches**
+  (CMS + candidate tables, HLL, entropy) — the "remote context" mode that
+  the reference ships with unbounded Prometheus maps becomes fixed-memory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.events.schema import (
+    F,
+    EV_DNS_REQ,
+    EV_DNS_RESP,
+    EV_TCP_RETRANS,
+    VERDICT_DROPPED,
+    VERDICT_FORWARDED,
+    DIR_INGRESS,
+    DIR_EGRESS,
+    PROTO_TCP,
+    PROTO_UDP,
+)
+from retina_tpu.models.identity import IdentityMap
+from retina_tpu.ops.conntrack import ConntrackTable
+from retina_tpu.ops.countmin import CountMinSketch
+from retina_tpu.ops.entropy import AnomalyEWMA, EntropyWindow
+from retina_tpu.ops.hyperloglog import HyperLogLog
+from retina_tpu.ops.topk import HeavyHitterSketch
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Static shapes of every aggregator (hashable; part of the jit key)."""
+
+    n_pods: int = 1 << 12  # dense pod-index space (0 = unknown/world)
+    n_drop_reasons: int = 16
+    n_dns_qtypes: int = 16
+    cms_depth: int = 4
+    cms_width: int = 1 << 15
+    topk_slots: int = 1 << 11
+    hll_precision: int = 12
+    hll_pod_precision: int = 8
+    entropy_buckets: int = 1 << 12
+    conntrack_slots: int = 1 << 18
+    latency_slots: int = 1 << 12
+    latency_buckets: int = 16  # exponential RTT histogram buckets
+    enable_conntrack: bool = True
+    enable_latency: bool = True
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PipelineState:
+    """All device-resident aggregation state, one pytree."""
+
+    # Dense exact rectangles (local-context mode).
+    pod_forward: jnp.ndarray  # (P, 2 dir, 2 {pkts, bytes}) uint32
+    pod_drop: jnp.ndarray  # (P, R, 2 {pkts, bytes}) uint32
+    pod_tcpflags: jnp.ndarray  # (P, 8 flags) uint32
+    pod_dns: jnp.ndarray  # (P, Q qtypes, 2 {req, resp}) uint32
+    pod_retrans: jnp.ndarray  # (P,) uint32
+    node_counters: jnp.ndarray  # (2 dir, 2 {pkts, bytes}) uint32, node-level
+    totals: jnp.ndarray  # (8,) uint32: [events, fwd, drop, dnsreq, dnsresp,
+    #                                    retrans, ct_reports, lost]
+    # Sketches (remote-context mode).
+    flow_hh: HeavyHitterSketch  # 5-tuple heavy hitters
+    svc_hh: HeavyHitterSketch  # (src_pod, dst_pod) service graph
+    dns_hh: HeavyHitterSketch  # DNS query-name-hash heavy hitters
+    hll_flows: HyperLogLog  # distinct 5-tuples, G=1
+    hll_src_per_reason: HyperLogLog  # distinct srcs per drop reason, G=R
+    hll_src_per_pod: HyperLogLog  # distinct srcs per dst pod, G=P
+    entropy: EntropyWindow  # G=3: src_ip, dst_ip, dst_port
+    anomaly: AnomalyEWMA  # G=3 EWMA over window entropies
+    conntrack: ConntrackTable
+    # apiserver latency: match table tsval-hash -> send-time, + histogram.
+    lat_key: jnp.ndarray  # (L,) uint32 match fingerprints
+    lat_ts: jnp.ndarray  # (L,) uint32 send time (ns >> 20, ~ms units)
+    lat_hist: jnp.ndarray  # (H,) uint32 RTT histogram (exponential buckets)
+
+    def tree_flatten(self):
+        fields = [f.name for f in dataclasses.fields(self)]
+        return tuple(getattr(self, n) for n in fields), tuple(fields)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(**dict(zip(aux, children)))
+
+
+class TelemetryPipeline:
+    """Builds zero state and the jitted step for a PipelineConfig."""
+
+    def __init__(self, config: PipelineConfig = PipelineConfig()):
+        self.config = config
+
+    def init_state(self) -> PipelineState:
+        c = self.config
+        u = lambda *shape: jnp.zeros(shape, jnp.uint32)
+        return PipelineState(
+            pod_forward=u(c.n_pods, 2, 2),
+            pod_drop=u(c.n_pods, c.n_drop_reasons, 2),
+            pod_tcpflags=u(c.n_pods, 8),
+            pod_dns=u(c.n_pods, c.n_dns_qtypes, 2),
+            pod_retrans=u(c.n_pods),
+            node_counters=u(2, 2),
+            totals=u(8),
+            flow_hh=HeavyHitterSketch.zeros(
+                4, c.cms_depth, c.cms_width, c.topk_slots, seed=1
+            ),
+            svc_hh=HeavyHitterSketch.zeros(
+                2, c.cms_depth, c.cms_width, c.topk_slots, seed=2
+            ),
+            dns_hh=HeavyHitterSketch.zeros(
+                1, c.cms_depth, c.cms_width, c.topk_slots, seed=3
+            ),
+            hll_flows=HyperLogLog.zeros(1, c.hll_precision, seed=4),
+            hll_src_per_reason=HyperLogLog.zeros(
+                c.n_drop_reasons, c.hll_precision, seed=5
+            ),
+            hll_src_per_pod=HyperLogLog.zeros(c.n_pods, c.hll_pod_precision, seed=6),
+            entropy=EntropyWindow.zeros(3, c.entropy_buckets, seed=7),
+            anomaly=AnomalyEWMA.zeros(3),
+            conntrack=ConntrackTable.zeros(c.conntrack_slots, seed=8),
+            lat_key=u(c.latency_slots),
+            lat_ts=u(c.latency_slots),
+            lat_hist=u(c.latency_buckets),
+        )
+
+    # ------------------------------------------------------------------
+    def step(
+        self,
+        state: PipelineState,
+        records: jnp.ndarray,  # (B, NUM_FIELDS) uint32
+        n_valid: jnp.ndarray,  # scalar uint32
+        now_s: jnp.ndarray,  # scalar uint32 wall seconds
+        ident: IdentityMap,
+        apiserver_ip: jnp.ndarray,  # scalar uint32 (0 = disabled)
+    ) -> tuple[PipelineState, dict[str, jnp.ndarray]]:
+        """Process one batch. Pure; jit via TelemetryPipeline.jitted_step."""
+        c = self.config
+        b = records.shape[0]
+        col = lambda i: records[:, i]
+        mask = jnp.arange(b, dtype=jnp.uint32) < n_valid
+
+        src_ip, dst_ip = col(F.SRC_IP), col(F.DST_IP)
+        ports, meta = col(F.PORTS), col(F.META)
+        proto = meta >> 24
+        tcp_flags = (meta >> 16) & jnp.uint32(0xFF)
+        direction = (meta >> 4) & jnp.uint32(0xF)
+        bytes_, packets = col(F.BYTES), col(F.PACKETS)
+        verdict = col(F.VERDICT)
+        reason = jnp.minimum(col(F.DROP_REASON), jnp.uint32(c.n_drop_reasons - 1))
+        ev_type = col(F.EVENT_TYPE)
+
+        is_fwd = mask & (verdict == VERDICT_FORWARDED)
+        is_drop = mask & (verdict == VERDICT_DROPPED)
+        is_dns_req = mask & (ev_type == EV_DNS_REQ)
+        is_dns_resp = mask & (ev_type == EV_DNS_RESP)
+        is_retrans = mask & (ev_type == EV_TCP_RETRANS)
+        is_ingress = direction == DIR_INGRESS
+
+        # ---- enrichment join: IP -> pod index (one gather each) ----
+        src_pod = jnp.where(mask, ident.lookup(src_ip), 0)
+        dst_pod = jnp.where(mask, ident.lookup(dst_ip), 0)
+        # The "local pod" of an event: dst for ingress, src for egress
+        # (reference forward.go:107-160 local-context basis).
+        local_pod = jnp.where(is_ingress, dst_pod, src_pod)
+        dir_idx = jnp.where(is_ingress, 0, 1).astype(jnp.uint32)
+
+        w_pkts = jnp.where(is_fwd, packets, 0)
+        w_bytes = jnp.where(is_fwd, bytes_, 0)
+
+        # ---- dense rectangles ----
+        P = c.n_pods
+        local_pod_c = jnp.minimum(local_pod, jnp.uint32(P - 1))
+        pf = state.pod_forward
+        pf = pf.at[local_pod_c, dir_idx, 0].add(w_pkts, mode="drop")
+        pf = pf.at[local_pod_c, dir_idx, 1].add(w_bytes, mode="drop")
+
+        pd = state.pod_drop
+        pd = pd.at[local_pod_c, reason, 0].add(jnp.where(is_drop, packets, 0), mode="drop")
+        pd = pd.at[local_pod_c, reason, 1].add(jnp.where(is_drop, bytes_, 0), mode="drop")
+
+        # tcp flags: one scatter per flag bit over the batch (8 scatters on
+        # a (P,8) table — XLA folds them into one fused loop).
+        ptf = state.pod_tcpflags
+        is_tcp = mask & (proto == PROTO_TCP)
+        for bit in range(8):
+            has = is_tcp & ((tcp_flags >> bit) & 1).astype(bool)
+            ptf = ptf.at[local_pod_c, bit].add(
+                jnp.where(has, packets, 0), mode="drop"
+            )
+
+        qtype = jnp.minimum(col(F.DNS) >> 16, jnp.uint32(c.n_dns_qtypes - 1))
+        pdns = state.pod_dns
+        pdns = pdns.at[local_pod_c, qtype, 0].add(
+            jnp.where(is_dns_req, 1, 0).astype(jnp.uint32), mode="drop"
+        )
+        pdns = pdns.at[local_pod_c, qtype, 1].add(
+            jnp.where(is_dns_resp, 1, 0).astype(jnp.uint32), mode="drop"
+        )
+
+        pret = state.pod_retrans.at[local_pod_c].add(
+            jnp.where(is_retrans, 1, 0).astype(jnp.uint32), mode="drop"
+        )
+
+        nc = state.node_counters
+        nc = nc.at[dir_idx, 0].add(w_pkts, mode="drop")
+        nc = nc.at[dir_idx, 1].add(w_bytes, mode="drop")
+
+        # ---- sketches ----
+        five = [src_ip, dst_ip, ports, proto]
+        flow_hh = state.flow_hh.update(five, jnp.where(is_fwd, packets, 0))
+        svc_w = jnp.where(is_fwd & (src_pod > 0) & (dst_pod > 0), packets, 0)
+        svc_hh = state.svc_hh.update([src_pod, dst_pod], svc_w)
+        dns_hh = state.dns_hh.update(
+            [col(F.DNS_QHASH)], jnp.where(is_dns_req, 1, 0).astype(jnp.uint32)
+        )
+
+        hll_flows = state.hll_flows.update(five, jnp.zeros_like(src_ip), mask)
+        hll_reason = state.hll_src_per_reason.update([src_ip], reason, is_drop)
+        hll_pod = state.hll_src_per_pod.update(
+            [src_ip], jnp.minimum(dst_pod, jnp.uint32(c.n_pods - 1)), is_ingress & mask
+        )
+
+        ones = jnp.where(mask, 1.0, 0.0)
+        ent = state.entropy
+        ent = ent.update([src_ip], jnp.zeros_like(src_ip), ones)
+        ent = ent.update([dst_ip], jnp.ones_like(src_ip), ones)
+        ent = ent.update(
+            [ports & jnp.uint32(0xFFFF)], jnp.full_like(src_ip, 2), ones
+        )
+
+        # ---- conntrack sampling ----
+        ct = state.conntrack
+        n_reports = jnp.uint32(0)
+        report = jnp.zeros((b,), bool)
+        rep_pkts = jnp.zeros((b,), jnp.uint32)
+        rep_bytes = jnp.zeros((b,), jnp.uint32)
+        if c.enable_conntrack:
+            ct, report, _, rep_pkts, rep_bytes = ct.process(
+                src_ip, dst_ip, ports, proto, tcp_flags, now_s, bytes_, mask
+            )
+            n_reports = jnp.sum(report).astype(jnp.uint32)
+
+        # ---- apiserver latency (reference latency.go:286-301: match
+        # TSval of outgoing apiserver packets to TSecr of replies) ----
+        lat_key, lat_ts, lat_hist = state.lat_key, state.lat_ts, state.lat_hist
+        if c.enable_latency:
+            L = c.latency_slots
+            from retina_tpu.ops.hashing import hash_cols, reduce_range
+
+            ts_ms = (col(F.TS_HI) << 12) | (col(F.TS_LO) >> 20)  # ns >> 20 ~ ms
+            out_to_api = mask & (dst_ip == apiserver_ip) & (col(F.TSVAL) > 0)
+            in_from_api = mask & (src_ip == apiserver_ip) & (col(F.TSECR) > 0)
+            k_out = hash_cols([dst_ip, col(F.TSVAL)], 0x1A7)
+            k_in = hash_cols([src_ip, col(F.TSECR)], 0x1A7)
+            slot_out = jnp.where(out_to_api, reduce_range(k_out, L), L)
+            lat_key = lat_key.at[slot_out].set(k_out, mode="drop")
+            lat_ts = lat_ts.at[slot_out].set(ts_ms, mode="drop")
+            slot_in = reduce_range(k_in, L).astype(jnp.int32)
+            hit = in_from_api & (lat_key[slot_in] == k_in)
+            rtt = jnp.where(hit, ts_ms - lat_ts[slot_in], 0)
+            # Invalidate matched slots: later segments echoing the same
+            # TSecr (normal TCP) must not re-record the sample, and a
+            # recycled TSval hours later must not match a stale entry.
+            lat_key = lat_key.at[jnp.where(hit, slot_in, L)].set(
+                jnp.uint32(0), mode="drop"
+            )
+            # exponential buckets: bucket = floor(log2(rtt_ms + 1)).
+            bug = jnp.floor(
+                jnp.log2(rtt.astype(jnp.float32) + 1.0)
+            ).astype(jnp.uint32)
+            bug = jnp.minimum(bug, jnp.uint32(c.latency_buckets - 1))
+            lat_hist = lat_hist.at[jnp.where(hit, bug, c.latency_buckets)].add(
+                jnp.where(hit, 1, 0).astype(jnp.uint32), mode="drop"
+            )
+
+        n_mask = jnp.sum(mask).astype(jnp.uint32)
+        totals = state.totals + jnp.stack(
+            [
+                n_mask,
+                jnp.sum(w_pkts).astype(jnp.uint32),
+                jnp.sum(jnp.where(is_drop, packets, 0)).astype(jnp.uint32),
+                jnp.sum(is_dns_req).astype(jnp.uint32),
+                jnp.sum(is_dns_resp).astype(jnp.uint32),
+                jnp.sum(is_retrans).astype(jnp.uint32),
+                n_reports,
+                jnp.uint32(0),
+            ]
+        )
+
+        new_state = PipelineState(
+            pod_forward=pf,
+            pod_drop=pd,
+            pod_tcpflags=ptf,
+            pod_dns=pdns,
+            pod_retrans=pret,
+            node_counters=nc,
+            totals=totals,
+            flow_hh=flow_hh,
+            svc_hh=svc_hh,
+            dns_hh=dns_hh,
+            hll_flows=hll_flows,
+            hll_src_per_reason=hll_reason,
+            hll_src_per_pod=hll_pod,
+            entropy=ent,
+            anomaly=state.anomaly,
+            conntrack=ct,
+            lat_key=lat_key,
+            lat_ts=lat_ts,
+            lat_hist=lat_hist,
+        )
+        summary = {
+            "events": n_mask,
+            "ct_reports": n_reports,
+            "report_mask": report,
+            "report_packets": rep_pkts,
+            "report_bytes": rep_bytes,
+        }
+        return new_state, summary
+
+    def end_window(
+        self, state: PipelineState, z_thresh: float = 4.0
+    ) -> tuple[PipelineState, dict[str, jnp.ndarray]]:
+        """Close an entropy window: compute entropies, update the anomaly
+        EWMA, reset the window histograms. Called once per window (1s)."""
+        h = state.entropy.entropy_bits()
+        anomaly, flags, z = state.anomaly.observe(h, z_thresh=z_thresh)
+        new = dataclasses.replace(
+            state, entropy=state.entropy.reset(), anomaly=anomaly
+        )
+        return new, {"entropy_bits": h, "anomaly": flags, "zscore": z}
+
+    # ------------------------------------------------------------------
+    def jitted_step(self):
+        return jax.jit(self.step, donate_argnums=(0,))
+
+    def jitted_end_window(self):
+        return jax.jit(self.end_window, donate_argnums=(0,))

@@ -1,0 +1,25 @@
+"""Test harness: force an 8-device virtual CPU mesh before any JAX use.
+
+The reference tests multi-node behavior without a cluster by faking the
+seams (SURVEY.md §4: envtest for the k8s API, gomock for the kernel). The
+TPU analog: fake the chips — XLA's host platform exposes N virtual CPU
+devices, so every sharding/collective path runs in CI with no TPU attached.
+bench.py does NOT import this and runs on real hardware.
+
+Note: the environment's TPU integration pins jax_platforms at interpreter
+start, so JAX_PLATFORMS env tweaks are too late; jax.config.update is the
+reliable override. Only one JAX process may use the real TPU at a time
+(tunnel lock), which is another reason tests must stay on CPU.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,123 @@
+"""Conntrack sampling semantics vs the reference's decision rules
+(conntrack.c ct_process_packet: SYN/FIN/RST always report; otherwise one
+report per CT_REPORT_INTERVAL per connection)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from retina_tpu.events.schema import TCP_ACK, TCP_SYN, TCP_FIN, pack_ports
+from retina_tpu.ops.conntrack import ConntrackTable, CT_REPORT_INTERVAL
+
+
+def _process_full(tbl, src, dst, sport, dport, flags, now, proto=6, n=1):
+    b = n
+    mk = lambda v: jnp.full((b,), v, jnp.uint32)
+    return tbl.process(
+        src_ip=mk(src),
+        dst_ip=mk(dst),
+        ports=mk(pack_ports(sport, dport)),
+        proto=mk(proto),
+        tcp_flags=mk(flags),
+        now_s=mk(now),
+        bytes_=mk(100),
+        mask=jnp.ones((b,), bool),
+    )
+
+
+def _process(tbl, src, dst, sport, dport, flags, now, proto=6, n=1):
+    tbl, rep, isrep, _, _ = _process_full(tbl, src, dst, sport, dport, flags, now, proto, n)
+    return tbl, rep, isrep
+
+
+def test_syn_always_reports():
+    tbl = ConntrackTable.zeros(1 << 10)
+    tbl, rep, _ = _process(tbl, 1, 2, 1000, 80, TCP_SYN, now=100)
+    assert bool(rep[0])
+
+
+def test_steady_state_sampled_to_interval():
+    tbl = ConntrackTable.zeros(1 << 10)
+    tbl, rep, _ = _process(tbl, 1, 2, 1000, 80, TCP_SYN, now=100)
+    reports = 0
+    for t in range(101, 101 + 2 * CT_REPORT_INTERVAL):
+        tbl, rep, _ = _process(tbl, 1, 2, 1000, 80, TCP_ACK, now=t)
+        reports += int(rep[0])
+    # 60 ACK packets over 2 intervals -> exactly 2 interval reports.
+    assert reports == 2, reports
+
+
+def test_within_batch_dedup():
+    tbl = ConntrackTable.zeros(1 << 10)
+    # 100 identical ACK packets in one batch, connection already known.
+    tbl, _, _ = _process(tbl, 1, 2, 1000, 80, TCP_SYN, now=100)
+    tbl, rep, _ = _process(
+        tbl, 1, 2, 1000, 80, TCP_ACK, now=100 + CT_REPORT_INTERVAL + 1, n=100
+    )
+    assert int(np.asarray(rep).sum()) == 1
+
+
+def test_reply_direction_detected():
+    tbl = ConntrackTable.zeros(1 << 10)
+    tbl, _, isrep = _process(tbl, 1, 2, 1000, 80, TCP_SYN, now=10)
+    assert not bool(isrep[0])
+    tbl, _, isrep = _process(tbl, 2, 1, 80, 1000, TCP_ACK, now=11)
+    assert bool(isrep[0])  # same connection, opposite direction
+
+
+def test_fin_reports_and_new_conn_after_expiry():
+    tbl = ConntrackTable.zeros(1 << 10)
+    tbl, _, _ = _process(tbl, 1, 2, 1000, 80, TCP_SYN, now=10)
+    tbl, rep, _ = _process(tbl, 1, 2, 1000, 80, TCP_FIN, now=11)
+    assert bool(rep[0])
+    # After TCP lifetime, same 5-tuple is a new connection -> reports again.
+    tbl, rep, isrep = _process(tbl, 1, 2, 1000, 80, TCP_ACK, now=1000)
+    assert bool(rep[0]) and not bool(isrep[0])
+
+
+def test_distinct_connections_tracked_separately():
+    tbl = ConntrackTable.zeros(1 << 12)
+    now = 50
+    tbl, rep, _ = _process(tbl, 1, 2, 1000, 80, TCP_ACK, now=now)
+    assert bool(rep[0])  # new conn
+    tbl, rep, _ = _process(tbl, 3, 4, 1000, 80, TCP_ACK, now=now)
+    assert bool(rep[0])  # different conn, also new
+    tbl, rep, _ = _process(tbl, 1, 2, 1000, 80, TCP_ACK, now=now + 1)
+    assert not bool(rep[0])  # known, within interval
+    assert int(tbl.active_connections(now + 1)) == 2
+
+
+def test_report_carries_accumulated_payload():
+    tbl = ConntrackTable.zeros(1 << 10)
+    tbl, rep, _, pk, by = _process_full(tbl, 1, 2, 1000, 80, TCP_SYN, now=100)
+    assert bool(rep[0]) and int(pk[0]) == 1 and int(by[0]) == 100
+    # 5 unreported ACKs accumulate...
+    for t in range(101, 106):
+        tbl, rep, _, pk, by = _process_full(tbl, 1, 2, 1000, 80, TCP_ACK, now=t)
+        assert not bool(rep[0])
+    # ...then the interval report carries all 6 packets / 600 bytes since
+    # the SYN report, and the accumulator resets.
+    tbl, rep, _, pk, by = _process_full(
+        tbl, 1, 2, 1000, 80, TCP_ACK, now=100 + CT_REPORT_INTERVAL
+    )
+    assert bool(rep[0]) and int(pk[0]) == 6 and int(by[0]) == 600
+    assert int(np.asarray(tbl.packets).sum()) == 0
+
+
+def test_hairpin_flow_reply_detected():
+    # src_ip == dst_ip (hairpin): port tiebreak must canonicalize both
+    # directions to one key.
+    tbl = ConntrackTable.zeros(1 << 10)
+    tbl, rep, isrep = _process(tbl, 7, 7, 1000, 80, TCP_SYN, now=10)
+    assert bool(rep[0]) and not bool(isrep[0])
+    tbl, rep, isrep = _process(tbl, 7, 7, 80, 1000, TCP_ACK, now=11)
+    assert not bool(rep[0])  # same connection, within interval
+    # initiator_ip can't distinguish hairpin directions (same IP), but the
+    # connection must not be treated as new.
+
+
+def test_udp_expiry_in_active_count():
+    tbl = ConntrackTable.zeros(1 << 10)
+    tbl, _, _ = _process(tbl, 1, 2, 53, 53, 0, now=100, proto=17)
+    tbl, _, _ = _process(tbl, 3, 4, 1000, 80, TCP_ACK, now=100, proto=6)
+    # At now=200: UDP (60s lifetime) expired, TCP (360s) still live.
+    assert int(tbl.active_connections(200)) == 1

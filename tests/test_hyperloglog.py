@@ -1,0 +1,69 @@
+"""HLL accuracy vs exact distinct counts (SURVEY.md §4 test model)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from retina_tpu.ops.hyperloglog import HyperLogLog
+
+
+def _update(hll, keys, groups=None):
+    b = len(keys)
+    k = jnp.asarray(keys, jnp.uint32)
+    g = jnp.asarray(groups if groups is not None else np.zeros(b), jnp.uint32)
+    return hll.update([k], g, jnp.ones((b,), bool))
+
+
+def test_small_cardinality_near_exact():
+    hll = HyperLogLog.zeros(1, precision=12)
+    hll = _update(hll, np.arange(100, dtype=np.uint32))
+    est = float(hll.estimate()[0])
+    assert abs(est - 100) / 100 < 0.05
+
+
+def test_large_cardinality_within_bound():
+    n = 200_000
+    hll = HyperLogLog.zeros(1, precision=12)
+    keys = np.random.default_rng(0).integers(0, 2**32, size=n, dtype=np.uint32)
+    n_exact = len(np.unique(keys))
+    hll = _update(hll, keys)
+    est = float(hll.estimate()[0])
+    # Standard error ~1.04/sqrt(4096) = 1.6%; allow 4 sigma.
+    assert abs(est - n_exact) / n_exact < 0.07, (est, n_exact)
+
+
+def test_duplicates_do_not_inflate():
+    hll = HyperLogLog.zeros(1, precision=10)
+    keys = np.tile(np.arange(50, dtype=np.uint32), 100)
+    hll = _update(hll, keys)
+    est = float(hll.estimate()[0])
+    assert abs(est - 50) < 8
+
+
+def test_groups_independent():
+    hll = HyperLogLog.zeros(3, precision=10)
+    keys = np.arange(3000, dtype=np.uint32)
+    groups = keys % 3
+    hll = _update(hll, keys, groups)
+    est = np.asarray(hll.estimate())
+    for e in est:
+        assert abs(e - 1000) / 1000 < 0.15
+
+
+def test_merge_equals_union():
+    a_keys = np.arange(0, 1000, dtype=np.uint32)
+    b_keys = np.arange(500, 1500, dtype=np.uint32)
+    a = _update(HyperLogLog.zeros(1, 11), a_keys)
+    b = _update(HyperLogLog.zeros(1, 11), b_keys)
+    merged = a.merge(b)
+    union = _update(HyperLogLog.zeros(1, 11), np.arange(0, 1500, dtype=np.uint32))
+    assert np.array_equal(np.asarray(merged.registers), np.asarray(union.registers))
+
+
+def test_mask_excludes_padding():
+    hll = HyperLogLog.zeros(1, precision=10)
+    k = jnp.asarray(np.arange(1000, dtype=np.uint32))
+    g = jnp.zeros((1000,), jnp.uint32)
+    mask = jnp.asarray(np.arange(1000) < 10)
+    hll = hll.update([k], g, mask)
+    est = float(hll.estimate()[0])
+    assert est < 30

@@ -1,0 +1,176 @@
+"""End-to-end pipeline step tests: synthetic flows in, aggregates out.
+
+Mirrors the reference's module tests (pkg/module/metrics/metrics_module
+_test.go feeds flows through the module loop and asserts metric outcomes).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from retina_tpu.events.schema import (
+    EventBuilder,
+    EV_DNS_REQ,
+    EV_DROP,
+    OP_TO_ENDPOINT,
+    OP_TO_STACK,
+    TCP_ACK,
+    TCP_SYN,
+    VERDICT_DROPPED,
+    ip_to_u32,
+)
+from retina_tpu.models.identity import IdentityMap
+from retina_tpu.models.pipeline import PipelineConfig, TelemetryPipeline
+
+
+SMALL = PipelineConfig(
+    n_pods=256,
+    cms_width=1 << 12,
+    topk_slots=1 << 8,
+    hll_precision=8,
+    hll_pod_precision=6,
+    entropy_buckets=1 << 8,
+    conntrack_slots=1 << 10,
+    latency_slots=1 << 8,
+)
+
+
+def _run(events_fn, ident=None, config=SMALL, capacity=512):
+    pipe = TelemetryPipeline(config)
+    state = pipe.init_state()
+    builder = EventBuilder(capacity)
+    events_fn(builder)
+    step = pipe.jitted_step()
+    ident = ident or IdentityMap.zeros(1 << 10)
+    for batch in builder.drain():
+        state, summary = step(
+            state,
+            jnp.asarray(batch.records),
+            jnp.uint32(batch.n_valid),
+            jnp.uint32(1000),
+            ident,
+            jnp.uint32(0),
+        )
+    return pipe, state, summary
+
+
+def test_forward_counters_per_pod():
+    pod_ip = ip_to_u32("10.0.0.5")
+    ident = IdentityMap.build_host({pod_ip: 7}, 1 << 10)
+
+    def gen(b):
+        for _ in range(10):  # ingress to pod 7: 10 pkts, 1000 bytes
+            b.add(src_ip=ip_to_u32("1.2.3.4"), dst_ip=pod_ip, bytes_=100,
+                  obs_point=OP_TO_ENDPOINT)
+        for _ in range(5):  # egress from pod 7
+            b.add(src_ip=pod_ip, dst_ip=ip_to_u32("1.2.3.4"), bytes_=50,
+                  obs_point=OP_TO_STACK)
+
+    _, state, _ = _run(gen, ident)
+    pf = np.asarray(state.pod_forward)
+    assert pf[7, 0, 0] == 10 and pf[7, 0, 1] == 1000  # ingress pkts/bytes
+    assert pf[7, 1, 0] == 5 and pf[7, 1, 1] == 250  # egress pkts/bytes
+    nc = np.asarray(state.node_counters)
+    assert nc[0, 0] == 10 and nc[1, 0] == 5
+
+
+def test_drop_counters_by_reason():
+    pod_ip = ip_to_u32("10.0.0.9")
+    ident = IdentityMap.build_host({pod_ip: 3}, 1 << 10)
+
+    def gen(b):
+        for _ in range(4):
+            b.add(src_ip=ip_to_u32("8.8.8.8"), dst_ip=pod_ip, bytes_=60,
+                  obs_point=OP_TO_ENDPOINT, verdict=VERDICT_DROPPED,
+                  drop_reason=2, event_type=EV_DROP)
+
+    _, state, _ = _run(gen, ident)
+    pd = np.asarray(state.pod_drop)
+    assert pd[3, 2, 0] == 4 and pd[3, 2, 1] == 240
+    assert np.asarray(state.totals)[2] == 4
+    # Forward counters must NOT count drops.
+    assert np.asarray(state.pod_forward)[3].sum() == 0
+
+
+def test_tcpflags_counted():
+    def gen(b):
+        b.add(src_ip=1, dst_ip=2, tcp_flags=TCP_SYN)
+        b.add(src_ip=1, dst_ip=2, tcp_flags=TCP_SYN | TCP_ACK)
+        b.add(src_ip=1, dst_ip=2, tcp_flags=TCP_ACK)
+
+    _, state, _ = _run(gen)
+    ptf = np.asarray(state.pod_tcpflags)[0]  # unknown pod bucket
+    assert ptf[1] == 2  # SYN bit set twice
+    assert ptf[4] == 2  # ACK bit set twice
+
+
+def test_dns_counters():
+    def gen(b):
+        for _ in range(3):
+            b.add(src_ip=5, dst_ip=6, event_type=EV_DNS_REQ,
+                  dns=(1 << 16), dns_qhash=0xABCD)
+
+    _, state, _ = _run(gen)
+    assert np.asarray(state.pod_dns)[0, 1, 0] == 3
+    assert np.asarray(state.totals)[3] == 3
+    keys, counts = state.dns_hh.table.top_k_host(1)
+    assert int(keys[0][0]) == 0xABCD and counts[0] == 3
+
+
+def test_flow_heavy_hitter_found():
+    hot = (ip_to_u32("10.1.1.1"), ip_to_u32("10.2.2.2"))
+
+    def gen(b):
+        rng = np.random.default_rng(0)
+        for i in range(200):
+            b.add(src_ip=hot[0], dst_ip=hot[1], src_port=5000, dst_port=80)
+        for i in range(100):
+            b.add(src_ip=int(rng.integers(1, 2**31)), dst_ip=int(rng.integers(1, 2**31)),
+                  src_port=1234, dst_port=80)
+
+    _, state, _ = _run(gen)
+    keys, counts = state.flow_hh.table.top_k_host(1)
+    assert int(keys[0][0]) == hot[0] and int(keys[0][1]) == hot[1]
+    assert counts[0] >= 200
+
+
+def test_service_graph_requires_known_pods():
+    a, bip = ip_to_u32("10.0.0.1"), ip_to_u32("10.0.0.2")
+    ident = IdentityMap.build_host({a: 1, bip: 2}, 1 << 10)
+
+    def gen(b):
+        for _ in range(50):
+            b.add(src_ip=a, dst_ip=bip)
+        for _ in range(60):  # unknown src -> not in service graph
+            b.add(src_ip=ip_to_u32("99.9.9.9"), dst_ip=bip)
+
+    _, state, _ = _run(gen, ident)
+    keys, counts = state.svc_hh.table.top_k_host(5)
+    assert len(keys) == 1  # only the known pod pair
+    assert (int(keys[0][0]), int(keys[0][1])) == (1, 2) and counts[0] == 50
+
+
+def test_entropy_window_and_anomaly_cycle():
+    def gen(b):
+        rng = np.random.default_rng(2)
+        for _ in range(300):
+            b.add(src_ip=int(rng.integers(1, 2**31)), dst_ip=7)
+
+    pipe, state, _ = _run(gen)
+    state, out = pipe.jitted_end_window()(state)
+    assert float(out["entropy_bits"][0]) > 6.0  # diverse srcs
+    assert float(out["entropy_bits"][1]) < 0.1  # single dst
+    # Window reset: histograms cleared.
+    assert float(np.asarray(state.entropy.counts).sum()) == 0
+
+
+def test_totals_and_conntrack_reports():
+    def gen(b):
+        for i in range(20):
+            b.add(src_ip=1, dst_ip=2, src_port=99, dst_port=80,
+                  tcp_flags=TCP_ACK, ts_ns=10**9)
+
+    _, state, summary = _run(gen)
+    t = np.asarray(state.totals)
+    assert t[0] == 20  # events
+    # One connection, first sighting in batch -> exactly 1 conntrack report.
+    assert t[6] == 1

@@ -1,0 +1,71 @@
+"""Heavy-hitter top-k recall/precision vs exact (BASELINE config 2 model)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from retina_tpu.ops.topk import HeavyHitterSketch, TopKTable
+
+
+def _zipf_stream(n, n_keys, seed=0, alpha=1.3):
+    rng = np.random.default_rng(seed)
+    keys = rng.zipf(alpha, size=n).clip(max=n_keys).astype(np.uint32)
+    return keys
+
+
+def test_topk_f1_on_zipf():
+    n = 200_000
+    keys = _zipf_stream(n, 50_000)
+    hh = HeavyHitterSketch.zeros(n_key_cols=1, width=1 << 14, n_slots=1 << 11)
+    for i in range(0, n, 50_000):
+        batch = jnp.asarray(keys[i : i + 50_000])
+        hh = hh.update([batch], jnp.ones((len(batch),), jnp.uint32))
+    got_keys, got_counts = hh.table.top_k_host(20)
+    exact = np.bincount(keys)
+    true_top = set(np.argsort(exact)[::-1][:20].tolist())
+    got = set(int(k[0]) for k in got_keys)
+    f1 = 2 * len(true_top & got) / (len(true_top) + len(got))
+    assert f1 >= 0.9, f1
+
+
+def test_counts_match_exact_for_heavies():
+    n = 100_000
+    keys = _zipf_stream(n, 10_000, seed=3)
+    hh = HeavyHitterSketch.zeros(n_key_cols=1, width=1 << 15)
+    hh = hh.update([jnp.asarray(keys)], jnp.ones((n,), jnp.uint32))
+    got_keys, got_counts = hh.table.top_k_host(5)
+    exact = np.bincount(keys)
+    for k, c in zip(got_keys, got_counts):
+        true = exact[int(k[0])]
+        assert true <= c <= true * 1.05 + 50  # CMS overestimate, small
+
+
+def test_multicolumn_keys_recovered_exactly():
+    # 5-tuple-style keys: the table stores the actual key columns, so the
+    # host reads back real IPs/ports, not fingerprints.
+    src = jnp.asarray(np.repeat([0x0A000001, 0x0A000002], 500), jnp.uint32)
+    dst = jnp.asarray(np.repeat([0xC0A80001, 0xC0A80002], 500), jnp.uint32)
+    hh = HeavyHitterSketch.zeros(n_key_cols=2)
+    hh = hh.update([src, dst], jnp.ones((1000,), jnp.uint32))
+    got_keys, got_counts = hh.table.top_k_host(2)
+    pairs = {(int(a), int(b)) for a, b in got_keys}
+    assert (0x0A000001, 0xC0A80001) in pairs
+    assert (0x0A000002, 0xC0A80002) in pairs
+    assert all(c == 500 for c in got_counts)
+
+
+def test_masked_rows_never_enter_table():
+    hh = HeavyHitterSketch.zeros(n_key_cols=1)
+    keys = jnp.asarray([1, 2, 3, 4], dtype=jnp.uint32)
+    w = jnp.asarray([1, 1, 0, 0], dtype=jnp.uint32)
+    hh = hh.update([keys], w)
+    got_keys, _ = hh.table.top_k_host(10)
+    got = {int(k[0]) for k in got_keys}
+    assert 3 not in got and 4 not in got
+
+
+def test_reset_clears():
+    hh = HeavyHitterSketch.zeros(n_key_cols=1)
+    hh = hh.update([jnp.asarray([5], dtype=jnp.uint32)], jnp.ones((1,), jnp.uint32))
+    hh = hh.reset()
+    got_keys, got_counts = hh.table.top_k_host(10)
+    assert len(got_counts) == 0
