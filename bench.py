@@ -1,0 +1,108 @@
+"""Benchmark: single-chip fused telemetry pipeline throughput.
+
+Measures flow-events/sec through the jitted TelemetryPipeline step — the
+path that replaces the reference's single-threaded Go ProcessFlow loop
+(pkg/module/metrics/metrics_module.go:283-303, the scaling bottleneck per
+SURVEY.md §3.2) — on a 1M-event Zipf replay (BASELINE config 2), plus
+heavy-hitter recall vs exact ground truth.
+
+Prints ONE JSON line:
+  {"metric": "flow_events_per_sec_per_chip", "value": N, "unit": "events/s",
+   "vs_baseline": value / 10e6}
+vs_baseline is measured against the north-star target of 10M
+flow-events/sec/node (BASELINE.md; the reference publishes no absolute
+numbers, so the target is the baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from retina_tpu.events.synthetic import TrafficGen
+    from retina_tpu.models.identity import IdentityMap
+    from retina_tpu.models.pipeline import PipelineConfig, TelemetryPipeline
+
+    batch = 1 << 17  # 131,072 events/step, 8 MiB of records
+    n_batches = 8  # 1M-event replay
+    timed_steps = 24
+
+    cfg = PipelineConfig()  # production shapes (2^18-slot conntrack, etc.)
+    pipeline = TelemetryPipeline(cfg)
+    step = pipeline.jitted_step()
+
+    gen = TrafficGen(n_flows=1_000_000, n_pods=2048, seed=42)
+    ident = IdentityMap.build_host(
+        {0x0A000000 + i: i for i in range(1, 2048)}, n_slots=1 << 16
+    )
+    host_batches = [gen.batch(batch) for i in range(n_batches)]
+    dev_batches = [jax.device_put(b) for b in host_batches]
+    n_valid = jnp.uint32(batch)
+    api_ip = jnp.uint32(0)
+
+    state = pipeline.init_state()
+    # Warmup: compile + first touch.
+    state, _ = step(state, dev_batches[0], n_valid, jnp.uint32(1), ident, api_ip)
+    state, _ = step(state, dev_batches[1], n_valid, jnp.uint32(1), ident, api_ip)
+    jax.block_until_ready(state.totals)
+
+    t0 = time.perf_counter()
+    for i in range(timed_steps):
+        state, _ = step(
+            state,
+            dev_batches[i % n_batches],
+            n_valid,
+            jnp.uint32(2 + i // 8),
+            ident,
+            api_ip,
+        )
+    jax.block_until_ready(state.totals)
+    dt = time.perf_counter() - t0
+    events_per_sec = timed_steps * batch / dt
+
+    # Heavy-hitter recall@k vs exact ground truth (BASELINE config 2).
+    from retina_tpu.events.schema import F
+
+    k = 50
+    keys, _ = state.flow_hh.table.top_k_host(256)
+    reported = {tuple(kk) for kk in keys}
+    true_ids = gen.true_top_k(k)
+    hits = 0
+    for fid in true_ids:
+        key = (
+            int(gen.src_ip[fid]),
+            int(gen.dst_ip[fid]),
+            int((gen.sport[fid] << np.uint32(16)) | gen.dport[fid]),
+            int(gen.proto[fid]),
+        )
+        hits += key in reported
+    recall = hits / k
+
+    print(
+        json.dumps(
+            {
+                "metric": "flow_events_per_sec_per_chip",
+                "value": round(events_per_sec),
+                "unit": "events/s",
+                "vs_baseline": round(events_per_sec / 10_000_000, 4),
+                "extra": {
+                    "heavy_hitter_recall_at_50": recall,
+                    "batch": batch,
+                    "timed_steps": timed_steps,
+                    "backend": jax.default_backend(),
+                    "events_total": int(np.asarray(state.totals)[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

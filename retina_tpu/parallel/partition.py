@@ -1,0 +1,91 @@
+"""Host-side event partitioning across mesh devices.
+
+Connection-consistent sharding: both directions of a connection must land
+on the same device, or per-device conntrack tables (ops/conntrack.py) would
+see half-connections and double-report. The partition key is therefore the
+same canonical (sorted-endpoint) key conntrack uses — mirroring how the
+reference's kernel conntrack keys the 5-tuple after reverse-key lookup
+(conntrack.c ct_process_packet :344).
+
+This is the numpy mirror of ops/hashing.py (host batcher must not touch
+the device), plus the bucketing that turns one (N, F) host batch into a
+(D, B, F) sharded batch with per-device validity counts and drop accounting
+(the reference never blocks, it counts losses — packetparser_linux.go:692-697).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from retina_tpu.events.schema import F, NUM_FIELDS
+
+_PHI32 = np.uint32(0x9E3779B9)
+
+
+def fmix32_np(x: np.ndarray) -> np.ndarray:
+    """Host mirror of ops.hashing.fmix32 (must stay bit-identical)."""
+    x = x.astype(np.uint32).copy()
+    x ^= x >> np.uint32(16)
+    x *= np.uint32(0x85EBCA6B)
+    x ^= x >> np.uint32(13)
+    x *= np.uint32(0xC2B2AE35)
+    x ^= x >> np.uint32(16)
+    return x
+
+
+def hash_cols_np(cols: list[np.ndarray], seed: int) -> np.ndarray:
+    """Host mirror of ops.hashing.hash_cols."""
+    h0 = (int(seed) * 0x9E3779B9) & 0xFFFFFFFF
+    h = np.full(cols[0].shape, h0, np.uint32)
+    for c in cols:
+        c = c.astype(np.uint32)
+        h = fmix32_np(h ^ (c + _PHI32 + (h << np.uint32(6)) + (h >> np.uint32(2))))
+    return h
+
+
+def canonical_conn_hash(records: np.ndarray, seed: int = 0x5A) -> np.ndarray:
+    """(N, F) records -> (N,) direction-independent connection hashes."""
+    src, dst = records[:, F.SRC_IP], records[:, F.DST_IP]
+    ports = records[:, F.PORTS]
+    proto = records[:, F.META] >> np.uint32(24)
+    sp, dp = ports >> np.uint32(16), ports & np.uint32(0xFFFF)
+    fwd = (src < dst) | ((src == dst) & (sp <= dp))
+    a_ip = np.where(fwd, src, dst).astype(np.uint32)
+    b_ip = np.where(fwd, dst, src).astype(np.uint32)
+    a_pt = np.where(fwd, sp, dp).astype(np.uint32)
+    b_pt = np.where(fwd, dp, sp).astype(np.uint32)
+    return hash_cols_np([a_ip, b_ip, (a_pt << np.uint32(16)) | b_pt, proto], seed)
+
+
+@dataclasses.dataclass
+class ShardedBatch:
+    """One host batch split across D devices."""
+
+    records: np.ndarray  # (D, B, NUM_FIELDS) uint32
+    n_valid: np.ndarray  # (D,) uint32
+    lost: int  # rows dropped because a shard overflowed
+
+
+def partition_events(
+    records: np.ndarray, n_devices: int, capacity: int
+) -> ShardedBatch:
+    """Split (N, F) valid records into a (D, B, F) sharded batch.
+
+    Overflowing rows are dropped and counted, never blocked on (the
+    reference's universal backpressure rule, SURVEY.md §3.2).
+    """
+    assert records.ndim == 2 and records.shape[1] == NUM_FIELDS
+    out = np.zeros((n_devices, capacity, NUM_FIELDS), np.uint32)
+    n_valid = np.zeros((n_devices,), np.uint32)
+    lost = 0
+    if len(records):
+        dev = canonical_conn_hash(records) % np.uint32(n_devices)
+        for d in range(n_devices):
+            rows = records[dev == d]
+            n = min(len(rows), capacity)
+            out[d, :n] = rows[:n]
+            n_valid[d] = n
+            lost += len(rows) - n
+    return ShardedBatch(records=out, n_valid=n_valid, lost=lost)
