@@ -1,0 +1,157 @@
+"""Layered agent configuration.
+
+Reference analog: pkg/config/config.go:59-125 — viper merges a YAML file
+with ``RETINA_``-prefixed environment variables into one static ``Config``
+struct consumed by the daemon. Same layering here: dataclass defaults ←
+YAML file ← ``RETINA_*`` env vars (env wins), via :func:`load_config`.
+
+TPU-specific knobs (batch capacity, window length, mesh shape, pipeline
+table sizes) live alongside the reference's flags because in this framework
+the "kernel" is the jit-compiled pipeline and its compile-time shape IS
+configuration — the analog of the reference injecting config into eBPF via
+generated dynamic.h macros (packetparser_linux.go:82-127).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+import yaml
+
+# Data aggregation levels (reference pkg/config/config.go:16-23).
+AGG_LOW = "low"
+AGG_HIGH = "high"
+
+DEFAULT_PLUGINS = ["packetparser", "dropreason", "packetforward", "dns"]
+
+
+@dataclasses.dataclass
+class Config:
+    """Static agent configuration (reference Config, config.go:59-77)."""
+
+    # --- reference-parity fields ---
+    api_server_addr: str = "127.0.0.1:10093"
+    enabled_plugins: list[str] = dataclasses.field(
+        default_factory=lambda: list(DEFAULT_PLUGINS)
+    )
+    metrics_interval_s: float = 10.0  # map-read plugin cadence
+    enable_telemetry: bool = False
+    enable_pod_level: bool = True
+    remote_context: bool = False
+    enable_annotations: bool = False
+    enable_conntrack_metrics: bool = True
+    bypass_lookup_ip_of_interest: bool = False
+    data_aggregation_level: str = AGG_LOW
+    telemetry_interval_s: float = 900.0
+    log_level: str = "info"
+    log_file: str = ""  # empty = stderr only
+
+    # --- TPU runtime knobs ---
+    device_platform: str = ""  # "" = let JAX pick; "cpu" to force host
+    batch_capacity: int = 1 << 15  # events per device batch
+    window_seconds: float = 1.0  # entropy/anomaly window
+    flush_interval_s: float = 0.05  # max host-side batching latency
+    mesh_devices: int = 0  # 0 = all local devices
+    snapshot_dir: str = ""  # sketch-state checkpoint dir ("" = off)
+    snapshot_interval_s: float = 0.0  # 0 = only on shutdown
+
+    # --- pipeline shapes (jit keys; see models/pipeline.py) ---
+    n_pods: int = 1 << 12
+    cms_width: int = 1 << 15
+    cms_depth: int = 4
+    topk_slots: int = 1 << 11
+    hll_precision: int = 12
+    entropy_buckets: int = 1 << 12
+    conntrack_slots: int = 1 << 18
+    identity_slots: int = 1 << 16
+
+    def validate(self) -> None:
+        if self.data_aggregation_level not in (AGG_LOW, AGG_HIGH):
+            raise ValueError(
+                f"dataAggregationLevel must be {AGG_LOW!r} or {AGG_HIGH!r}, "
+                f"got {self.data_aggregation_level!r}"
+            )
+        for f in ("batch_capacity", "n_pods", "cms_width", "topk_slots",
+                  "entropy_buckets", "conntrack_slots", "identity_slots"):
+            v = getattr(self, f)
+            if v <= 0 or (v & (v - 1)):
+                raise ValueError(f"{f} must be a positive power of two, got {v}")
+
+
+_BOOL_TRUE = {"1", "true", "yes", "on"}
+
+
+def _coerce(value: str, target_type: Any) -> Any:
+    if target_type is bool:
+        return value.strip().lower() in _BOOL_TRUE
+    if target_type is int:
+        return int(value, 0)
+    if target_type is float:
+        return float(value)
+    if target_type is list or target_type == list[str]:
+        return [p.strip() for p in value.split(",") if p.strip()]
+    return value
+
+
+# YAML keys accepted in camelCase (reference configmap style) or snake_case.
+def _normalize_key(key: str) -> str:
+    out = []
+    for ch in key:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out).lstrip("_")
+
+
+_ALIASES = {
+    "enabled_plugin": "enabled_plugins",
+    "enabled_plugin_linux": "enabled_plugins",
+    "metrics_interval_duration": "metrics_interval_s",
+    "telemetry_interval": "telemetry_interval_s",
+}
+
+
+def load_config(
+    path: str | None = None,
+    overrides: dict[str, Any] | None = None,
+    env: dict[str, str] | None = None,
+) -> Config:
+    """YAML file ← RETINA_* env ← explicit overrides (later wins)."""
+    cfg = Config()
+    fields = {f.name: f for f in dataclasses.fields(Config)}
+
+    def apply(key: str, raw: Any, from_env: bool) -> None:
+        key = _ALIASES.get(_normalize_key(key), _normalize_key(key))
+        if key not in fields:
+            return  # unknown keys ignored, like viper
+        f = fields[key]
+        ftype = f.type if not isinstance(f.type, str) else {
+            "str": str, "int": int, "float": float, "bool": bool,
+            "list[str]": list,
+        }.get(f.type, str)
+        if from_env or isinstance(raw, str) and ftype is not str:
+            raw = _coerce(str(raw), ftype)
+        setattr(cfg, key, raw)
+
+    if path:
+        with open(path) as fh:
+            doc = yaml.safe_load(fh) or {}
+        if not isinstance(doc, dict):
+            raise ValueError(f"config file {path} must be a YAML mapping")
+        for k, v in doc.items():
+            apply(k, v, from_env=False)
+
+    env = dict(os.environ if env is None else env)
+    for k, v in env.items():
+        if k.startswith("RETINA_"):
+            apply(k[len("RETINA_"):].lower(), v, from_env=True)
+
+    for k, v in (overrides or {}).items():
+        apply(k, v, from_env=False)
+
+    cfg.validate()
+    return cfg

@@ -1,0 +1,108 @@
+"""Prometheus exporter registries.
+
+Reference analog: pkg/exporter/prometheusexporter.go:17-40 — three
+registries: **Default** (basic node-level metrics, lives for the process),
+**Advanced** (pod-level metrics, RESET whenever a MetricsConfiguration CRD
+reconcile changes the metric set, :35-40), and a **Combined** gatherer the
+HTTP server scrapes. Constructor helpers mirror :46-88.
+
+Built on prometheus_client's CollectorRegistry; the combined gatherer is a
+merge of both registries' samples at scrape time, and reset callbacks let
+the HTTP server re-register its handler like the reference does.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
+from prometheus_client.exposition import generate_latest
+
+from retina_tpu.log import logger
+
+_log = logger("exporter")
+
+
+class Exporter:
+    """Holds the default + advanced registries (reference package state)."""
+
+    def __init__(self) -> None:
+        self.default_registry = CollectorRegistry()
+        self.advanced_registry = CollectorRegistry()
+        self._reset_cbs: list[Callable[[], None]] = []
+        self._lock = threading.Lock()
+
+    # -- reset (prometheusexporter.go:35-40) --
+    def reset_advanced(self) -> None:
+        """Replace the advanced registry (CRD reconcile changed metrics)."""
+        with self._lock:
+            self.advanced_registry = CollectorRegistry()
+            cbs = list(self._reset_cbs)
+        _log.info("advanced metrics registry reset")
+        for cb in cbs:
+            cb()
+
+    def on_reset(self, cb: Callable[[], None]) -> None:
+        with self._lock:
+            self._reset_cbs.append(cb)
+
+    # -- combined gatherer (prometheusexporter.go:17-33) --
+    def gather_text(self) -> bytes:
+        """Prometheus text exposition of both registries."""
+        with self._lock:
+            regs: Iterable[CollectorRegistry] = (
+                self.default_registry,
+                self.advanced_registry,
+            )
+        return b"".join(generate_latest(r) for r in regs)
+
+    # -- constructor helpers (prometheusexporter.go:46-88) --
+    def new_gauge(self, name: str, labels: list[str], help_: str = "") -> Gauge:
+        return Gauge(
+            name, help_ or name, labels, registry=self.default_registry
+        )
+
+    def new_counter(self, name: str, labels: list[str], help_: str = "") -> Counter:
+        return Counter(
+            name, help_ or name, labels, registry=self.default_registry
+        )
+
+    def new_histogram(
+        self, name: str, labels: list[str], buckets: list[float], help_: str = ""
+    ) -> Histogram:
+        return Histogram(
+            name, help_ or name, labels,
+            buckets=buckets, registry=self.default_registry,
+        )
+
+    def new_adv_gauge(self, name: str, labels: list[str], help_: str = "") -> Gauge:
+        with self._lock:
+            reg = self.advanced_registry
+        return Gauge(name, help_ or name, labels, registry=reg)
+
+    def new_adv_counter(
+        self, name: str, labels: list[str], help_: str = ""
+    ) -> Counter:
+        with self._lock:
+            reg = self.advanced_registry
+        return Counter(name, help_ or name, labels, registry=reg)
+
+
+_singleton: Exporter | None = None
+_lock = threading.Lock()
+
+
+def get_exporter() -> Exporter:
+    global _singleton
+    with _lock:
+        if _singleton is None:
+            _singleton = Exporter()
+        return _singleton
+
+
+def reset_for_tests() -> None:
+    """Fresh registries so tests don't collide on metric names."""
+    global _singleton
+    with _lock:
+        _singleton = None

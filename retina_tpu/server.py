@@ -1,0 +1,144 @@
+"""Agent HTTP server: /metrics, /healthz, /readyz, /debug/pprof.
+
+Reference analog: pkg/server/server.go — a chi mux serving promhttp over
+the combined gatherer (:61-63), pprof handlers (:46-56), and health
+endpoints wired by the daemon (cmd/standard/daemon.go:217-222) so kubelet
+can restart an unhealthy agent.
+
+Python analog: a ThreadingHTTPServer. /debug/pprof/profile runs cProfile
+for ``seconds=N`` and returns pstats text; /debug/pprof/heap returns a
+tracemalloc snapshot if tracing is on; /debug/vars dumps runtime counters.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import json
+import pstats
+import threading
+import time
+import tracemalloc
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from retina_tpu.exporter import Exporter, get_exporter
+from retina_tpu.log import logger
+from retina_tpu.utils import buildinfo
+
+_log = logger("server")
+
+
+class Server:
+    """HTTP server manager (reference pkg/server + servermanager)."""
+
+    def __init__(
+        self,
+        addr: str = "127.0.0.1:10093",
+        exporter: Optional[Exporter] = None,
+        ready_check: Optional[Callable[[], bool]] = None,
+        healthy_check: Optional[Callable[[], bool]] = None,
+    ) -> None:
+        host, _, port = addr.rpartition(":")
+        self._host, self._port = host or "127.0.0.1", int(port)
+        self._exporter = exporter or get_exporter()
+        self._ready = ready_check or (lambda: True)
+        self._healthy = healthy_check or (lambda: True)
+        self._vars: dict[str, Callable[[], object]] = {}
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def expose_var(self, name: str, fn: Callable[[], object]) -> None:
+        """Register a /debug/vars entry (expvar analog)."""
+        self._vars[name] = fn
+
+    @property
+    def port(self) -> int:
+        """Bound port (useful when constructed with port 0 in tests)."""
+        assert self._httpd is not None
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: object) -> None:
+                pass  # route request logs to our logger at debug only
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802
+                try:
+                    url = urlparse(self.path)
+                    route = url.path.rstrip("/") or "/"
+                    if route == "/metrics":
+                        self._send(
+                            200,
+                            srv._exporter.gather_text(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    elif route == "/healthz":
+                        ok = srv._healthy()
+                        self._send(200 if ok else 503,
+                                   b"ok" if ok else b"unhealthy", "text/plain")
+                    elif route == "/readyz":
+                        ok = srv._ready()
+                        self._send(200 if ok else 503,
+                                   b"ok" if ok else b"not ready", "text/plain")
+                    elif route == "/version":
+                        self._send(200, buildinfo.VERSION.encode(), "text/plain")
+                    elif route == "/debug/vars":
+                        doc = {k: f() for k, f in srv._vars.items()}
+                        self._send(200, json.dumps(doc, default=str).encode(),
+                                   "application/json")
+                    elif route == "/debug/pprof/profile":
+                        q = parse_qs(url.query)
+                        seconds = min(float(q.get("seconds", ["1"])[0]), 30.0)
+                        prof = cProfile.Profile()
+                        prof.enable()
+                        time.sleep(seconds)
+                        prof.disable()
+                        out = io.StringIO()
+                        pstats.Stats(prof, stream=out).sort_stats(
+                            "cumulative"
+                        ).print_stats(50)
+                        self._send(200, out.getvalue().encode(), "text/plain")
+                    elif route == "/debug/pprof/heap":
+                        if not tracemalloc.is_tracing():
+                            tracemalloc.start()
+                            self._send(202, b"tracing started; re-request",
+                                       "text/plain")
+                            return
+                        snap = tracemalloc.take_snapshot()
+                        lines = [str(s) for s in snap.statistics("lineno")[:50]]
+                        self._send(200, "\n".join(lines).encode(), "text/plain")
+                    else:
+                        self._send(404, b"not found", "text/plain")
+                except BrokenPipeError:
+                    pass
+                except Exception:
+                    _log.exception("handler error path=%s", self.path)
+                    try:
+                        self._send(500, b"internal error", "text/plain")
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-server", daemon=True
+        )
+        self._thread.start()
+        _log.info("http server listening on %s:%d", self._host, self.port)
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

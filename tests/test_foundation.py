@@ -1,0 +1,200 @@
+"""Foundation-layer tests: config, pubsub, exporter, metrics, server,
+common objects, telemetry.
+
+Mirrors the reference's unit style (SURVEY.md §4): no cluster, no kernel —
+pure in-process contracts, HTTP asserted over a real localhost socket the
+way e2e metric checks parse the exposition format
+(test/e2e/framework/prometheus/prometheus.go:25-50).
+"""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from retina_tpu.common import DirtyCache, RetinaEndpoint, retry
+from retina_tpu.config import AGG_HIGH, Config, load_config
+from retina_tpu.exporter import Exporter
+from retina_tpu.metrics import Metrics
+from retina_tpu.pubsub import PubSub
+from retina_tpu.server import Server
+from retina_tpu.telemetry import Telemetry, new_telemetry
+
+
+# ---------------------------------------------------------------- config
+def test_config_defaults_valid():
+    cfg = Config()
+    cfg.validate()
+    assert "packetparser" in cfg.enabled_plugins
+
+
+def test_config_yaml_env_layering(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text(
+        "enabledPlugin: [dropreason, dns]\n"
+        "metricsIntervalDuration: 5\n"
+        "enablePodLevel: true\n"
+        "dataAggregationLevel: high\n"
+    )
+    cfg = load_config(
+        str(p),
+        env={"RETINA_BATCH_CAPACITY": "4096", "RETINA_REMOTE_CONTEXT": "true"},
+    )
+    assert cfg.enabled_plugins == ["dropreason", "dns"]
+    assert cfg.metrics_interval_s == 5
+    assert cfg.data_aggregation_level == AGG_HIGH
+    assert cfg.batch_capacity == 4096  # env wins over default
+    assert cfg.remote_context is True
+
+
+def test_config_rejects_bad_values(tmp_path):
+    with pytest.raises(ValueError):
+        load_config(None, overrides={"data_aggregation_level": "medium"})
+    with pytest.raises(ValueError):
+        load_config(None, overrides={"batch_capacity": 1000})  # not pow2
+
+
+# ---------------------------------------------------------------- pubsub
+def test_pubsub_publish_subscribe_unsubscribe():
+    ps = PubSub()
+    got: list[int] = []
+    done = threading.Event()
+
+    def cb(msg):
+        got.append(msg)
+        done.set()
+
+    sub = ps.subscribe("t", cb)
+    ps.publish("t", 42)
+    assert done.wait(2.0)
+    assert got == [42]
+
+    ps.unsubscribe("t", sub)
+    ps.publish("t", 43)
+    time.sleep(0.05)
+    assert got == [42]
+    with pytest.raises(KeyError):
+        ps.unsubscribe("t", sub)
+    ps.shutdown()
+
+
+def test_pubsub_subscriber_exception_isolated():
+    ps = PubSub()
+    ok = threading.Event()
+    ps.subscribe("t", lambda m: (_ for _ in ()).throw(RuntimeError("boom")))
+    ps.subscribe("t", lambda m: ok.set())
+    ps.publish("t", 1)
+    assert ok.wait(2.0)
+    ps.shutdown()
+
+
+# ------------------------------------------------------------- exporter
+def test_exporter_registries_and_reset():
+    ex = Exporter()
+    g = ex.new_gauge("test_basic_gauge", ["l"])
+    g.labels(l="a").set(3)
+    adv = ex.new_adv_gauge("test_adv_gauge", [])
+    adv.set(7)
+    text = ex.gather_text().decode()
+    assert 'test_basic_gauge{l="a"} 3.0' in text
+    assert "test_adv_gauge 7.0" in text
+
+    fired = []
+    ex.on_reset(lambda: fired.append(1))
+    ex.reset_advanced()
+    text = ex.gather_text().decode()
+    assert "test_basic_gauge" in text  # default survives
+    assert "test_adv_gauge" not in text  # advanced wiped
+    assert fired == [1]
+
+
+def test_metrics_declarations():
+    ex = Exporter()
+    m = Metrics(ex)
+    m.forward_count.labels(direction="ingress").set(10)
+    m.lost_events.labels(stage="buffered", plugin="packetparser").inc(5)
+    text = ex.gather_text().decode()
+    assert 'networkobservability_forward_count{direction="ingress"} 10.0' in text
+    assert "networkobservability_lost_events_counter_total" in text
+
+
+# --------------------------------------------------------------- server
+def test_server_endpoints():
+    ex = Exporter()
+    g = ex.new_gauge("test_served_gauge", [])
+    g.set(5)
+    ready = {"ok": False}
+    srv = Server("127.0.0.1:0", exporter=ex, ready_check=lambda: ready["ok"])
+    srv.expose_var("answer", lambda: 42)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "test_served_gauge 5.0" in body
+        assert urllib.request.urlopen(f"{base}/healthz").status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/readyz")
+        assert ei.value.code == 503
+        ready["ok"] = True
+        assert urllib.request.urlopen(f"{base}/readyz").status == 200
+        import json
+
+        doc = json.loads(urllib.request.urlopen(f"{base}/debug/vars").read())
+        assert doc["answer"] == 42
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------------- common
+def test_retina_endpoint_and_dirtycache():
+    ep = RetinaEndpoint(
+        name="web-0",
+        namespace="default",
+        ips=("10.0.0.5",),
+        labels=(("app", "web"),),
+        owner_refs=(("StatefulSet", "web"),),
+    )
+    assert ep.key() == "default/web-0"
+    assert ep.workload() == "web"
+    assert ep.labels_dict() == {"app": "web"}
+
+    dc = DirtyCache()
+    dc.to_add("k", ep)
+    dc.to_delete("k", ep)  # delete supersedes add
+    assert dc.get_add_list() == []
+    assert dc.get_delete_list() == [ep]
+    dc.clear_delete()
+    assert dc.get_delete_list() == []
+
+
+def test_retry_backoff():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry(flaky, attempts=5, base_delay_s=0.001) == "ok"
+    assert calls["n"] == 3
+
+    with pytest.raises(OSError):
+        retry(lambda: (_ for _ in ()).throw(OSError("always")),
+              attempts=2, base_delay_s=0.001)
+
+
+# ------------------------------------------------------------ telemetry
+def test_telemetry_heartbeat_and_noop():
+    ex = Exporter()
+    ex.new_gauge("test_card_gauge", ["x"]).labels(x="1").set(1)
+    t = Telemetry(interval_s=1e9, exporter=ex)
+    hb = t.heartbeat()
+    assert hb["metrics_cardinality"] >= 1
+    assert hb["rss_bytes"] > 0
+    with t.perf_span("reconcile"):
+        pass
+
+    noop = new_telemetry(enabled=False)
+    assert noop.heartbeat() == {}
