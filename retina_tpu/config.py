@@ -48,6 +48,15 @@ class Config:
     log_level: str = "info"
     log_file: str = ""  # empty = stderr only
 
+    # --- event source (the kernel-hook analog; SURVEY.md §7 mapping) ---
+    event_source: str = "synthetic"  # synthetic | pcap | live | external
+    pcap_path: str = ""  # replay file for event_source=pcap
+    pcap_loop: bool = True  # loop the replay
+    synthetic_rate: float = 1e6  # target events/s for the generator
+    synthetic_flows: int = 100_000
+    capture_iface: str = ""  # live AF_PACKET interface ("" = default)
+    external_socket: str = "/tmp/retina-events.sock"  # external feed
+
     # --- TPU runtime knobs ---
     device_platform: str = ""  # "" = let JAX pick; "cpu" to force host
     batch_capacity: int = 1 << 15  # events per device batch

@@ -1,0 +1,41 @@
+"""Data-plane plugins (reference pkg/plugin, SURVEY.md §2.2).
+
+Importing this package registers every platform-supported plugin with the
+registry (the reference's ``init()`` + ``registry.Add`` self-registration,
+registry.go:42-47).
+"""
+
+import sys
+
+from retina_tpu.plugins import registry
+from retina_tpu.plugins.api import (
+    EventSink,
+    Plugin,
+    QueueSink,
+    UnsupportedPlatform,
+)
+
+# Self-registration imports (each module calls registry.add at import).
+from retina_tpu.plugins import (  # noqa: F401
+    conntrack_gc,
+    dns,
+    dropreason,
+    externalevents,
+    infiniband,
+    linuxutil,
+    mockplugin,
+    packetforward,
+    packetparser,
+    tcpretrans,
+)
+
+if sys.platform == "win32":  # pragma: no cover - parity stubs
+    from retina_tpu.plugins import windows  # noqa: F401
+
+__all__ = [
+    "EventSink",
+    "Plugin",
+    "QueueSink",
+    "UnsupportedPlatform",
+    "registry",
+]

@@ -1,0 +1,129 @@
+"""externalevents: ingest records from an external process.
+
+Reference analog: pkg/plugin/ciliumeventobserver — connects to another
+dataplane's monitor unix socket, decodes its payloads, and re-emits them
+as Retina flows (ciliumeventobserver_linux.go). Generalized here: a unix
+socket server accepting length-prefixed msgpack frames
+``{"records": <bytes of (N,16) uint32 le>, "dns_names": {hash: name}}``
+from any producer (another agent, a Go control plane, a replay tool),
+re-emitted into the sink.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+
+import msgpack
+import numpy as np
+
+from retina_tpu.config import Config
+from retina_tpu.events.schema import NUM_FIELDS
+from retina_tpu.plugins import registry
+from retina_tpu.plugins.api import Plugin
+
+MAX_FRAME = 64 << 20
+
+
+def send_frame(sock: socket.socket, records: np.ndarray,
+               dns_names: dict[int, str] | None = None) -> None:
+    """Producer-side helper: ship a record block to the plugin socket."""
+    payload = msgpack.packb(
+        {
+            "records": np.ascontiguousarray(records, np.uint32).tobytes(),
+            "dns_names": dns_names or {},
+        }
+    )
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+@registry.register
+class ExternalEventsPlugin(Plugin):
+    name = "externalevents"
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self._server: socket.socket | None = None
+
+    def init(self) -> None:
+        path = self.cfg.external_socket
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        self._server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._server.bind(path)
+        self._server.listen(4)
+        self._server.settimeout(0.2)
+        self.log.info("listening on %s", path)
+
+    def _serve_conn(self, conn: socket.socket, stop: threading.Event) -> None:
+        conn.settimeout(0.2)
+        buf = b""
+        while not stop.is_set():
+            try:
+                chunk = conn.recv(1 << 20)
+            except (TimeoutError, socket.timeout):
+                continue
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            while len(buf) >= 4:
+                (n,) = struct.unpack_from("<I", buf)
+                if n > MAX_FRAME:
+                    self.log.error("frame too large (%d bytes); dropping conn", n)
+                    conn.close()
+                    return
+                if len(buf) < 4 + n:
+                    break
+                frame, buf = buf[4 : 4 + n], buf[4 + n :]
+                self._handle_frame(frame)
+        conn.close()
+
+    def _handle_frame(self, frame: bytes) -> None:
+        try:
+            doc = msgpack.unpackb(frame, strict_map_key=False)
+            raw = doc["records"]
+            rec = np.frombuffer(raw, np.uint32).reshape(-1, NUM_FIELDS).copy()
+        except Exception:
+            self.count_lost("decode", 1)
+            self.log.exception("bad external frame")
+            return
+        names = doc.get("dns_names") or {}
+        if names:
+            from retina_tpu.plugins.dns import TOPIC_DNS_NAMES
+            from retina_tpu.pubsub import get_pubsub
+
+            get_pubsub().publish(TOPIC_DNS_NAMES, dict(names))
+        self.emit(rec)
+
+    def start(self, stop: threading.Event) -> None:
+        assert self._server is not None
+        workers: list[threading.Thread] = []
+        while not stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except (TimeoutError, socket.timeout):
+                continue
+            except OSError:
+                break
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn, stop), daemon=True
+            )
+            t.start()
+            workers.append(t)
+        for t in workers:
+            t.join(timeout=1.0)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+            try:
+                os.unlink(self.cfg.external_socket)
+            except OSError:
+                pass

@@ -1,0 +1,176 @@
+"""packetparser: the flow-event firehose plugin.
+
+Reference analog: pkg/plugin/packetparser — tc classifiers parse every
+packet on the host device + pod veths into ``struct packet`` records that
+stream to userspace over a perf ring and become flows
+(packetparser_linux.go:556-652). Here the packet-parse step is the
+host-side decoder (sources/pcapdecode.py, optionally the C++ native fast
+path), and the plugin's start loop streams decoded record blocks into the
+sink at a paced rate. Conntrack sampling/enrichment runs on-device inside
+the pipeline step rather than in a kernel map (ops/conntrack.py).
+
+Sources (cfg.event_source):
+- ``synthetic``: TrafficGen Zipf flows (the trafficgen analog) at
+  cfg.synthetic_rate events/s.
+- ``pcap``: replay cfg.pcap_path (optionally looped), preserving record
+  order; DNS names feed the host string table via pubsub.
+- ``live``: AF_PACKET raw-socket capture (root only), decoded in batches.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from retina_tpu.config import Config
+from retina_tpu.events.synthetic import TrafficGen
+from retina_tpu.plugins import registry
+from retina_tpu.plugins.api import Plugin, UnsupportedPlatform
+
+BLOCK = 8192  # records per emitted block
+
+
+@registry.register
+class PacketParserPlugin(Plugin):
+    name = "packetparser"
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self._gen: TrafficGen | None = None
+        self._pcap_records: np.ndarray | None = None
+        self.dns_names: dict[int, str] = {}
+        self._sock = None
+
+    # -- lifecycle ---------------------------------------------------
+    def generate(self) -> None:
+        src = self.cfg.event_source
+        if src not in ("synthetic", "pcap", "live"):
+            raise ValueError(f"packetparser: unknown event_source {src!r}")
+        if src == "pcap" and not self.cfg.pcap_path:
+            raise ValueError("packetparser: event_source=pcap needs pcap_path")
+
+    def compile(self) -> None:
+        """Decode/prepare the source up front (the clang-compile analog:
+        pay parse cost before Start, never in the hot loop)."""
+        src = self.cfg.event_source
+        if src == "synthetic":
+            self._gen = TrafficGen(
+                n_flows=self.cfg.synthetic_flows, n_pods=self.cfg.n_pods
+            )
+        elif src == "pcap":
+            from retina_tpu.sources.pcapdecode import decode_pcap_file
+
+            res = decode_pcap_file(self.cfg.pcap_path)
+            self._pcap_records = res.records
+            self.dns_names = res.dns_names
+            self.log.info(
+                "pcap decoded: %d/%d packets from %s",
+                res.n_decoded, res.n_packets_total, self.cfg.pcap_path,
+            )
+
+    def init(self) -> None:
+        if self.cfg.event_source == "live":
+            self._open_socket()
+
+    def _open_socket(self) -> None:
+        import socket
+
+        try:
+            self._sock = socket.socket(
+                socket.AF_PACKET, socket.SOCK_RAW, socket.htons(3)  # ETH_P_ALL
+            )
+        except (PermissionError, AttributeError, OSError) as e:
+            raise UnsupportedPlatform(
+                f"live capture needs AF_PACKET + root: {e}"
+            ) from e
+        if self.cfg.capture_iface:
+            self._sock.bind((self.cfg.capture_iface, 0))
+        self._sock.settimeout(0.1)
+
+    # -- feed loop ---------------------------------------------------
+    def start(self, stop: threading.Event) -> None:
+        src = self.cfg.event_source
+        if src == "synthetic":
+            self._run_synthetic(stop)
+        elif src == "pcap":
+            self._run_pcap(stop)
+        else:
+            self._run_live(stop)
+
+    def _run_synthetic(self, stop: threading.Event) -> None:
+        assert self._gen is not None
+        per_block_s = BLOCK / max(self.cfg.synthetic_rate, 1.0)
+        next_t = time.monotonic()
+        while not stop.is_set():
+            self.emit(self._gen.batch(BLOCK))
+            next_t += per_block_s
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                stop.wait(delay)
+            else:
+                next_t = time.monotonic()  # behind: don't accumulate debt
+
+    def _run_pcap(self, stop: threading.Event) -> None:
+        recs = self._pcap_records
+        assert recs is not None
+        if len(recs) == 0:
+            self.log.warning("pcap replay: no decodable packets")
+            stop.wait()
+            return
+        pos = 0
+        while not stop.is_set():
+            block = recs[pos : pos + BLOCK]
+            self.emit(block)
+            pos += BLOCK
+            if pos >= len(recs):
+                if not self.cfg.pcap_loop:
+                    self.log.info("pcap replay complete")
+                    return
+                pos = 0
+            if self.cfg.synthetic_rate > 0:
+                stop.wait(len(block) / self.cfg.synthetic_rate)
+
+    def _run_live(self, stop: threading.Event) -> None:
+        from retina_tpu.sources.pcapdecode import synthesize_pcap, decode_pcap_bytes
+
+        assert self._sock is not None
+        import socket as socket_mod
+        import struct as struct_mod
+
+        # Wrap raw frames in an in-memory pcap so one decoder serves all
+        # sources (and the C++ fast path drops in transparently).
+        hdr = struct_mod.pack(
+            "<IHHiIII", 0xA1B23C4D, 2, 4, 0, 0, 65535, 1
+        )
+        while not stop.is_set():
+            frames: list[bytes] = []
+            deadline = time.monotonic() + 0.05
+            while time.monotonic() < deadline and len(frames) < BLOCK:
+                try:
+                    frames.append(self._sock.recv(65535))
+                except (TimeoutError, socket_mod.timeout):
+                    break
+                except OSError:
+                    return
+            if not frames:
+                continue
+            now = time.time_ns()
+            parts = [hdr]
+            for fr in frames:
+                parts.append(
+                    struct_mod.pack(
+                        "<IIII", now // 10**9, now % 10**9, len(fr), len(fr)
+                    )
+                )
+                parts.append(fr)
+            res = decode_pcap_bytes(b"".join(parts))
+            if res.dns_names:
+                self.dns_names.update(res.dns_names)
+            self.emit(res.records)
+
+    def stop(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None

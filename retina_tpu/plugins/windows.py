@@ -1,0 +1,44 @@
+"""Windows plugins (parity stubs).
+
+Reference analogs: pkg/plugin/hnsstats (HNS/VFP port counters via hcsshim)
+and pkg/plugin/pktmon (pktmon server subprocess streamed over gRPC). Both
+are Windows-kernel surfaces with no Linux/TPU-host equivalent; they
+register only on win32 and raise UnsupportedPlatform elsewhere, matching
+the reference's _windows.go build tags.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+from retina_tpu.config import Config
+from retina_tpu.plugins import registry
+from retina_tpu.plugins.api import Plugin, UnsupportedPlatform
+
+
+class HnsStatsPlugin(Plugin):
+    name = "hnsstats"
+
+    def init(self) -> None:
+        if sys.platform != "win32":
+            raise UnsupportedPlatform("hnsstats requires Windows HNS")
+
+    def start(self, stop: threading.Event) -> None:
+        raise UnsupportedPlatform("hnsstats requires Windows HNS")
+
+
+class PktmonPlugin(Plugin):
+    name = "pktmon"
+
+    def init(self) -> None:
+        if sys.platform != "win32":
+            raise UnsupportedPlatform("pktmon requires Windows")
+
+    def start(self, stop: threading.Event) -> None:
+        raise UnsupportedPlatform("pktmon requires Windows")
+
+
+if sys.platform == "win32":  # pragma: no cover
+    registry.add(HnsStatsPlugin.name, HnsStatsPlugin)
+    registry.add(PktmonPlugin.name, PktmonPlugin)

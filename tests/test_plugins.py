@@ -1,0 +1,268 @@
+"""Plugin-layer tests: registry contract, lifecycle, sinks, the proc-stat
+plugins against fake /proc//sys roots, packetparser sources, external
+events over a unix socket — the reference's plugin unit-test strategy of
+mocking the kernel seam (SURVEY.md §4)."""
+
+import queue
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import retina_tpu.plugins  # noqa: F401  (trigger self-registration)
+from retina_tpu.config import Config
+from retina_tpu.events.schema import EV_DNS_REQ, EV_DNS_RESP, F, NUM_FIELDS
+from retina_tpu.exporter import reset_for_tests as reset_exporter
+from retina_tpu.metrics import get_metrics, reset_for_tests as reset_metrics
+from retina_tpu.plugins import registry
+from retina_tpu.plugins.api import QueueSink
+from retina_tpu.plugins.dns import DnsPlugin
+from retina_tpu.plugins.dropreason import DropReasonPlugin
+from retina_tpu.plugins.externalevents import ExternalEventsPlugin, send_frame
+from retina_tpu.plugins.linuxutil import LinuxUtilPlugin
+from retina_tpu.plugins.mockplugin import MockPlugin
+from retina_tpu.plugins.packetparser import PacketParserPlugin
+from retina_tpu.plugins.tcpretrans import TcpRetransPlugin
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    reset_exporter()
+    reset_metrics()
+    yield
+    MockPlugin.fail_stage = None
+
+
+def metric_value(metric, **labels):
+    return metric.labels(**labels)._value.get()
+
+
+# ------------------------------------------------------------- registry
+def test_registry_contract():
+    names = registry.names()
+    for expected in ("packetparser", "dropreason", "packetforward", "dns",
+                     "tcpretrans", "linuxutil", "infiniband", "conntrack",
+                     "externalevents", "mock"):
+        assert expected in names
+    with pytest.raises(ValueError):
+        registry.add("mock", MockPlugin)  # dup panics
+    with pytest.raises(KeyError):
+        registry.get("nonexistent")
+
+
+def test_mock_plugin_lifecycle_and_emit():
+    cfg = Config()
+    p = MockPlugin(cfg)
+    sink = QueueSink()
+    p.set_sink(sink)
+    ext: queue.Queue = queue.Queue(maxsize=2)
+    p.setup_channel(ext)
+    p.generate(); p.compile(); p.init()
+    stop = threading.Event()
+    t = threading.Thread(target=p.start, args=(stop,), daemon=True)
+    t.start()
+    assert p.started.wait(2.0)
+    time.sleep(0.05)
+    stop.set()
+    t.join(2.0)
+    p.stop()
+    assert p.calls[:4] == ["generate", "compile", "init", "start"]
+    assert p.calls[-1] == "stop"
+    blocks = sink.drain(max_blocks=1000)
+    assert blocks and all(name == "mock" for _, name in blocks)
+    assert not ext.empty()  # external channel mirrored
+
+
+def test_queue_sink_overflow_counts_lost():
+    cfg = Config()
+    p = MockPlugin(cfg)
+    sink = QueueSink(max_blocks=1)
+    p.set_sink(sink)
+    rec = np.zeros((10, NUM_FIELDS), np.uint32)
+    p.emit(rec)
+    p.emit(rec)  # overflows
+    lost = metric_value(get_metrics().lost_events, stage="buffered",
+                        plugin="mock")
+    assert lost == 10
+
+
+# ----------------------------------------------------- proc-stat plugins
+@pytest.fixture
+def fake_proc(tmp_path):
+    net = tmp_path / "proc" / "net"
+    net.mkdir(parents=True)
+    (net / "snmp").write_text(
+        "Ip: InReceives OutRequests InDiscards\n"
+        "Ip: 1000 900 5\n"
+        "Tcp: ActiveOpens CurrEstab RetransSegs InSegs\n"
+        "Tcp: 10 3 7 5000\n"
+        "Udp: InDatagrams OutDatagrams InErrors\n"
+        "Udp: 200 180 1\n"
+    )
+    (net / "netstat").write_text(
+        "TcpExt: ListenOverflows ListenDrops EmbryonicRsts\n"
+        "TcpExt: 2 3 1\n"
+    )
+    (net / "softnet_stat").write_text(
+        "0000aaaa 00000005 00000000\n0000bbbb 00000003 00000000\n"
+    )
+    return str(tmp_path / "proc")
+
+
+@pytest.fixture
+def fake_sys(tmp_path):
+    stats = tmp_path / "sys" / "class" / "net" / "eth9" / "statistics"
+    stats.mkdir(parents=True)
+    (stats / "rx_bytes").write_text("12345\n")
+    (stats / "tx_bytes").write_text("6789\n")
+    (stats / "rx_packets").write_text("100\n")
+    (stats / "tx_packets").write_text("90\n")
+    return str(tmp_path / "sys")
+
+
+def test_linuxutil_reads_fake_proc(fake_proc, fake_sys):
+    p = LinuxUtilPlugin(Config())
+    p.proc_root, p.sys_root = fake_proc, fake_sys
+    p.read_and_publish()
+    m = get_metrics()
+    assert metric_value(m.tcp_connection_stats, statistic_name="CurrEstab") == 3
+    assert metric_value(m.udp_connection_stats,
+                        statistic_name="InDatagrams") == 200
+    assert metric_value(m.ip_connection_stats,
+                        statistic_name="InReceives") == 1000
+    assert metric_value(m.interface_stats, interface_name="eth9",
+                        statistic_name="rx_bytes") == 12345
+
+
+def test_dropreason_deltas(fake_proc):
+    p = DropReasonPlugin(Config())
+    p.proc_root = fake_proc
+    p.init()  # base snapshot
+    p.read_and_publish()
+    m = get_metrics()
+    # deltas since init are 0
+    assert metric_value(m.drop_count, reason="softnet_drop",
+                        direction="ingress") == 0
+    # bump softnet drops in the fake
+    import pathlib
+
+    (pathlib.Path(fake_proc) / "net" / "softnet_stat").write_text(
+        "0000aaaa 0000000a 00000000\n0000bbbb 00000003 00000000\n"
+    )
+    p.read_and_publish()
+    assert metric_value(m.drop_count, reason="softnet_drop",
+                        direction="ingress") == 5
+
+
+def test_tcpretrans_delta(fake_proc):
+    p = TcpRetransPlugin(Config())
+    p.proc_root = fake_proc
+    p.init()
+    p.read_and_publish()
+    assert metric_value(get_metrics().tcp_connection_stats,
+                        statistic_name="RetransSegs") == 0
+
+
+# -------------------------------------------------------- packetparser
+def test_packetparser_synthetic_paced():
+    cfg = Config()
+    cfg.event_source = "synthetic"
+    cfg.synthetic_rate = 1e9  # no pacing in test
+    p = PacketParserPlugin(cfg)
+    sink = QueueSink()
+    p.set_sink(sink)
+    p.generate(); p.compile(); p.init()
+    stop = threading.Event()
+    t = threading.Thread(target=p.start, args=(stop,), daemon=True)
+    t.start()
+    time.sleep(0.1)
+    stop.set(); t.join(2.0); p.stop()
+    blocks = sink.drain(1000)
+    assert blocks
+    rec, name = blocks[0]
+    assert name == "packetparser" and rec.shape[1] == NUM_FIELDS
+
+
+def test_packetparser_pcap_replay(tmp_path):
+    from retina_tpu.sources.pcapdecode import synthesize_pcap
+
+    pcap = tmp_path / "t.pcap"
+    pcap.write_bytes(
+        synthesize_pcap(
+            [dict(src_ip=i + 1, dst_ip=99, ts_ns=i * 1000) for i in range(10)]
+        )
+    )
+    cfg = Config()
+    cfg.event_source = "pcap"
+    cfg.pcap_path = str(pcap)
+    cfg.pcap_loop = False
+    cfg.synthetic_rate = 0  # full speed
+    p = PacketParserPlugin(cfg)
+    sink = QueueSink()
+    p.set_sink(sink)
+    p.generate(); p.compile(); p.init()
+    stop = threading.Event()
+    p.start(stop)  # runs to completion (no loop)
+    blocks = sink.drain(100)
+    total = sum(len(r) for r, _ in blocks)
+    assert total == 10
+
+
+def test_packetparser_bad_config():
+    cfg = Config()
+    cfg.event_source = "pcap"
+    with pytest.raises(ValueError):
+        PacketParserPlugin(cfg).generate()
+
+
+# ------------------------------------------------------------------ dns
+def test_dns_plugin_observe_and_resolve():
+    cfg = Config()
+    p = DnsPlugin(cfg)
+    rec = np.zeros((3, NUM_FIELDS), np.uint32)
+    rec[0, F.EVENT_TYPE] = EV_DNS_REQ
+    rec[0, F.DNS] = 1 << 16  # A query
+    rec[1, F.EVENT_TYPE] = EV_DNS_RESP
+    rec[1, F.DNS] = (1 << 16) | (3 << 8)  # A, NXDOMAIN
+    p.observe_records(rec)
+    m = get_metrics()
+    assert metric_value(m.dns_request_count, query_type="A") == 1
+    assert metric_value(m.dns_response_count, query_type="A",
+                        return_code="NXDOMAIN") == 1
+    p._on_names({0xDEAD: "svc.cluster.local"})
+    assert p.resolve(0xDEAD) == "svc.cluster.local"
+    assert p.resolve(0x1234).startswith("unknown:")
+
+
+# -------------------------------------------------------- externalevents
+def test_externalevents_roundtrip(tmp_path):
+    cfg = Config()
+    cfg.external_socket = str(tmp_path / "ev.sock")
+    p = ExternalEventsPlugin(cfg)
+    sink = QueueSink()
+    p.set_sink(sink)
+    p.init()
+    stop = threading.Event()
+    t = threading.Thread(target=p.start, args=(stop,), daemon=True)
+    t.start()
+    try:
+        rec = np.arange(2 * NUM_FIELDS, dtype=np.uint32).reshape(2, NUM_FIELDS)
+        c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        c.connect(cfg.external_socket)
+        send_frame(c, rec, {1: "x.example.com"})
+        c.close()
+        deadline = time.monotonic() + 3.0
+        blocks = []
+        while time.monotonic() < deadline and not blocks:
+            blocks = sink.drain(10)
+            time.sleep(0.01)
+        assert blocks, "no records received"
+        got, name = blocks[0]
+        assert name == "externalevents"
+        np.testing.assert_array_equal(got, rec)
+    finally:
+        stop.set()
+        t.join(2.0)
+        p.stop()
