@@ -1,0 +1,280 @@
+"""SketchEngine: the TPU worker that replaces the CPU aggregation loop.
+
+Reference analog (what this replaces, SURVEY.md §3.2): the enricher output
+ring → ``Module.run`` goroutine calling every metric's ``ProcessFlow`` per
+flow (metrics_module.go:283-303) — single-threaded CPU hash aggregation,
+the scaling bottleneck. Per the BASELINE north star, this engine is the
+"tpusketch" plugin's backend: plugins feed fixed-width record blocks into
+a bounded queue (QueueSink), the feed loop batches them into fixed-shape
+device arrays, and ONE jit-compiled step updates every aggregator. Sharded
+over a ``jax.sharding.Mesh`` when more than one device is available
+(parallel/telemetry.py); scrape-time snapshots merge with psum/pmax/
+all_gather over ICI.
+
+Backpressure contract (the reference's universal rule,
+packetparser_linux.go:692-697): never block a producer — drop and count.
+Snapshot contract: scrapes read a cached merged snapshot at most
+``snapshot_max_age_s`` old (<1s target, BASELINE) and never stall the feed
+loop; JAX dispatch is async so the feed thread keeps the device busy while
+snapshot results transfer back.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.config import Config
+from retina_tpu.events.schema import NUM_FIELDS
+from retina_tpu.log import logger
+from retina_tpu.metrics import get_metrics
+from retina_tpu.models.identity import IdentityMap
+from retina_tpu.models.pipeline import PipelineConfig, TelemetryPipeline
+from retina_tpu.parallel.partition import partition_events
+from retina_tpu.parallel.telemetry import ShardedTelemetry, topk_from_snapshot
+from retina_tpu.plugins.api import QueueSink
+
+
+def pipeline_config_from(cfg: Config) -> PipelineConfig:
+    return PipelineConfig(
+        n_pods=cfg.n_pods,
+        cms_width=cfg.cms_width,
+        cms_depth=cfg.cms_depth,
+        topk_slots=cfg.topk_slots,
+        hll_precision=cfg.hll_precision,
+        entropy_buckets=cfg.entropy_buckets,
+        conntrack_slots=cfg.conntrack_slots,
+        enable_conntrack=cfg.enable_conntrack_metrics,
+        bypass_filter=cfg.bypass_lookup_ip_of_interest
+        or not cfg.enable_pod_level,
+    )
+
+
+class SketchEngine:
+    """Owns device state + the feed/window loop; thread-safe facade."""
+
+    def __init__(self, cfg: Config, devices: Optional[list] = None):
+        self.cfg = cfg
+        self.log = logger("engine")
+        self.sink = QueueSink(max_blocks=1024)
+        self.pcfg = pipeline_config_from(cfg)
+
+        devs = devices if devices is not None else jax.devices()
+        if cfg.mesh_devices > 0:
+            devs = devs[: cfg.mesh_devices]
+        self.n_devices = len(devs)
+        from jax.sharding import Mesh
+
+        self.mesh = Mesh(np.array(devs), ("data",))
+        self.sharded = ShardedTelemetry(self.pcfg, self.mesh)
+        self.state = self.sharded.init_state()
+
+        self._ident_lock = threading.Lock()
+        self.ident = IdentityMap.zeros(cfg.identity_slots)
+        self.filter_map = IdentityMap.zeros(1 << 10, seed=99)
+        self.apiserver_ip = 0
+
+        self._observers: list[Callable[[np.ndarray, str], None]] = []
+        self._snap_lock = threading.Lock()
+        self._snap_cache: dict[str, Any] | None = None
+        self._snap_time = 0.0
+        self.last_window: dict[str, np.ndarray] = {}
+        self._state_lock = threading.Lock()
+        self.started = threading.Event()
+        self._steps = 0
+        self._events_in = 0
+
+    # -- identity / filter wiring (set by cache & filtermanager) ------
+    def update_identities(self, ip_to_index: dict[int, int]) -> None:
+        ident = IdentityMap.build_host(
+            ip_to_index, n_slots=self.cfg.identity_slots
+        )
+        with self._ident_lock:
+            self.ident = ident
+
+    def update_filter_ips(self, ips: set[int]) -> None:
+        fmap = IdentityMap.build_host(
+            {ip: 1 for ip in ips}, n_slots=1 << 10, seed=99
+        )
+        with self._ident_lock:
+            self.filter_map = fmap
+
+    def set_apiserver_ips(self, ips: list[int]) -> None:
+        self.apiserver_ip = ips[0] if ips else 0
+
+    def add_observer(self, fn: Callable[[np.ndarray, str], None]) -> None:
+        """Observers see every accepted record block on the feed thread
+        (dns tally, flow export...). Must be fast and never raise."""
+        self._observers.append(fn)
+
+    # -- lifecycle ----------------------------------------------------
+    def compile(self) -> None:
+        """Warm every jit cache (the clang-compile analog) so the feed
+        loop and the first scrape never pay compile latency."""
+        t0 = time.perf_counter()
+        zero = np.zeros(
+            (self.n_devices, self.cfg.batch_capacity, NUM_FIELDS), np.uint32
+        )
+        nv = np.zeros((self.n_devices,), np.uint32)
+        self.state, _ = self.sharded.step(
+            self.state, zero, nv, 1, self.ident, self.apiserver_ip,
+            filter_map=self.filter_map,
+        )
+        self.state, _ = self.sharded.end_window(self.state)
+        snap = self.sharded.snapshot(self.state, 1)
+        jax.block_until_ready(snap["totals"])
+        self.log.info(
+            "engine compiled: %d device(s), batch=%d, %.1fs",
+            self.n_devices, self.cfg.batch_capacity,
+            time.perf_counter() - t0,
+        )
+
+    def step_records(self, records: np.ndarray, now_s: int | None = None) -> None:
+        """Feed one host block synchronously (tests / direct callers)."""
+        self._dispatch(records, now_s or int(time.time()))
+
+    def _dispatch(self, records: np.ndarray, now_s: int) -> None:
+        sb = partition_events(
+            records, self.n_devices, self.cfg.batch_capacity
+        )
+        with self._ident_lock:
+            ident = self.ident
+            fmap = self.filter_map
+        m = get_metrics()
+        if sb.lost:
+            m.lost_events.labels(stage="partition", plugin="engine").inc(sb.lost)
+        t0 = time.perf_counter()
+        with self._state_lock:
+            self.state, _ = self.sharded.step(
+                self.state, sb.records, sb.n_valid, now_s, ident,
+                self.apiserver_ip, filter_map=fmap, lost=sb.lost,
+            )
+        m.device_step_seconds.observe(time.perf_counter() - t0)
+        m.device_batch_fill.set(float(sb.n_valid.sum()) / (
+            self.n_devices * self.cfg.batch_capacity))
+        self._steps += 1
+        self._events_in += len(records)
+
+    def _close_window(self) -> None:
+        with self._state_lock:
+            self.state, win = self.sharded.end_window(self.state)
+        self.last_window = {k: np.asarray(v) for k, v in win.items()}
+        m = get_metrics()
+        m.windows_closed.inc()
+        dims = ["src_ip", "dst_ip", "dst_port"]
+        for i, dim in enumerate(dims):
+            m.entropy_bits.labels(dimension=dim).set(
+                float(self.last_window["entropy_bits"][i])
+            )
+            m.anomaly_flag.labels(dimension=dim).set(
+                float(self.last_window["anomaly"][i])
+            )
+            m.anomaly_zscore.labels(dimension=dim).set(
+                float(self.last_window["zscore"][i])
+            )
+
+    def start(self, stop: threading.Event) -> None:
+        """Feed loop: drain sink → batch → device; close windows on time.
+
+        Sits where Enricher.Run + Module.run sit in the reference
+        (enricher.go:68-99, metrics_module.go:266-330)."""
+        self.started.set()
+        cap = self.cfg.batch_capacity * self.n_devices
+        pending: list[np.ndarray] = []
+        n_pending = 0
+        last_flush = time.monotonic()
+        next_window = time.monotonic() + self.cfg.window_seconds
+        while not stop.is_set():
+            blocks = self.sink.drain(max_blocks=256)
+            for rec, plugin in blocks:
+                for obs in self._observers:
+                    try:
+                        obs(rec, plugin)
+                    except Exception:
+                        self.log.exception("observer failed")
+                pending.append(rec)
+                n_pending += len(rec)
+            now = time.monotonic()
+            flush_due = n_pending > 0 and (
+                n_pending >= cap or now - last_flush >= self.cfg.flush_interval_s
+            )
+            if flush_due:
+                all_rec = np.concatenate(pending, axis=0)
+                pending.clear()
+                n_pending = 0
+                last_flush = now
+                for off in range(0, len(all_rec), cap):
+                    self._dispatch(
+                        all_rec[off : off + cap], int(time.time())
+                    )
+            if now >= next_window:
+                try:
+                    self._close_window()
+                except Exception:
+                    self.log.exception("window close failed")
+                next_window = now + self.cfg.window_seconds
+            if not blocks and not flush_due:
+                stop.wait(0.002)
+
+    # -- scrape-time readout -----------------------------------------
+    def snapshot(self, max_age_s: float = 0.5) -> dict[str, Any]:
+        """Merged numpy snapshot, cached up to ``max_age_s`` (scrape
+        latency budget: <1s per BASELINE)."""
+        now = time.monotonic()
+        with self._snap_lock:
+            if self._snap_cache is not None and now - self._snap_time < max_age_s:
+                return self._snap_cache
+        with self._state_lock:
+            dev_snap = self.sharded.snapshot(self.state, int(time.time()))
+        host = {
+            k: (np.asarray(v) if not isinstance(v, dict)
+                else {kk: np.asarray(vv) for kk, vv in v.items()})
+            for k, v in dev_snap.items()
+        }
+        host["steps"] = self._steps
+        host["events_in"] = self._events_in
+        with self._snap_lock:
+            self._snap_cache = host
+            self._snap_time = time.monotonic()
+        return host
+
+    def top_flows(self, k: int = 20) -> tuple[np.ndarray, np.ndarray]:
+        return topk_from_snapshot(self.snapshot(), "flow_hh", k)
+
+    def top_services(self, k: int = 20) -> tuple[np.ndarray, np.ndarray]:
+        return topk_from_snapshot(self.snapshot(), "svc_hh", k)
+
+    def top_dns(self, k: int = 20) -> tuple[np.ndarray, np.ndarray]:
+        return topk_from_snapshot(self.snapshot(), "dns_hh", k)
+
+    def conntrack_gc(self) -> dict[str, int]:
+        """Scrape conntrack liveness (expiry itself is timestamp-based in
+        the table — the GC 'loop' is an accounting pass, conntrack plugin).
+        """
+        snap = self.snapshot(max_age_s=5.0)
+        totals = snap["totals"]
+        return {
+            "active": int(snap["active_conns"]),
+            "reports": int(totals[6]),
+            "packets": int(totals[1]),
+            "bytes": 0,
+        }
+
+    # -- checkpoint/resume (reference: pinned BPF maps survive agent
+    # restarts, pkg/bpf/setup_linux.go; SURVEY.md §5.4) ---------------
+    def save_snapshot_state(self, path: str) -> None:
+        from retina_tpu.checkpoint import save_state
+
+        with self._state_lock:
+            save_state(path, self.state, self.pcfg)
+
+    def load_snapshot_state(self, path: str) -> None:
+        from retina_tpu.checkpoint import load_state
+
+        with self._state_lock:
+            self.state = load_state(path, self.sharded, self.pcfg)

@@ -66,6 +66,13 @@ class PipelineConfig:
     latency_buckets: int = 16  # exponential RTT histogram buckets
     enable_conntrack: bool = True
     enable_latency: bool = True
+    # Kernel-side filtering analog (reference _cprog/retina_filter.c:24-34:
+    # the LPM "IPs of interest" lookup gates event emission; config
+    # BYPASS_LOOKUP_IP_OF_INTEREST disables it, packetparser.c:151-158).
+    # Here: events where neither endpoint resolves to a pod identity nor to
+    # an entry in the explicit filter map are masked out of every
+    # aggregator. bypass_filter=True admits everything.
+    bypass_filter: bool = True
 
 
 @jax.tree_util.register_pytree_node_class
@@ -154,6 +161,7 @@ class TelemetryPipeline:
         now_s: jnp.ndarray,  # scalar uint32 wall seconds
         ident: IdentityMap,
         apiserver_ip: jnp.ndarray,  # scalar uint32 (0 = disabled)
+        filter_map: IdentityMap | None = None,  # explicit IPs of interest
     ) -> tuple[PipelineState, dict[str, jnp.ndarray]]:
         """Process one batch. Pure; jit via TelemetryPipeline.jitted_step."""
         c = self.config
@@ -181,6 +189,20 @@ class TelemetryPipeline:
         # ---- enrichment join: IP -> pod index (one gather each) ----
         src_pod = jnp.where(mask, ident.lookup(src_ip), 0)
         dst_pod = jnp.where(mask, ident.lookup(dst_ip), 0)
+
+        # ---- IPs-of-interest filter (retina_filter.c lookup() analog) ----
+        if not c.bypass_filter:
+            interest = (src_pod > 0) | (dst_pod > 0)
+            if filter_map is not None:
+                interest |= (filter_map.lookup(src_ip) > 0) | (
+                    filter_map.lookup(dst_ip) > 0
+                )
+            mask &= interest
+            is_fwd &= interest
+            is_drop &= interest
+            is_dns_req &= interest
+            is_dns_resp &= interest
+            is_retrans &= interest
         # The "local pod" of an event: dst for ingress, src for egress
         # (reference forward.go:107-160 local-context basis).
         local_pod = jnp.where(is_ingress, dst_pod, src_pod)

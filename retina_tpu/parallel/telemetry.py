@@ -68,10 +68,13 @@ class ShardedTelemetry:
 
     # ------------------------------------------------------------------
     def _build_step(self):
-        def local_step(state, records, n_valid, now_s, ident, apiserver_ip, lost):
+        def local_step(
+            state, records, n_valid, now_s, ident, apiserver_ip, filt, lost
+        ):
             s = jax.tree.map(lambda x: x[0], state)
             new, summary = self.pipeline.step(
-                s, records[0], n_valid[0], now_s, ident, apiserver_ip
+                s, records[0], n_valid[0], now_s, ident, apiserver_ip,
+                filter_map=filt,
             )
             # Host-side partition overflow losses land in totals[7] ("lost")
             # on one device only, so the snapshot psum counts them once —
@@ -96,7 +99,7 @@ class ShardedTelemetry:
         fn = jax.shard_map(
             local_step,
             mesh=self.mesh,
-            in_specs=(sh, sh, sh, P(), P(), P(), P()),
+            in_specs=(sh, sh, sh, P(), P(), P(), P(), P()),
             out_specs=(
                 sh,
                 {
@@ -118,10 +121,13 @@ class ShardedTelemetry:
         now_s,  # scalar uint32
         ident: IdentityMap,
         apiserver_ip=0,
+        filter_map: IdentityMap | None = None,  # explicit IPs of interest
         lost=0,  # host-side partition overflow count (ShardedBatch.lost)
     ) -> tuple[PipelineState, dict[str, jnp.ndarray]]:
         if self._step is None:
             self._step = self._build_step()
+        if filter_map is None:
+            filter_map = IdentityMap.zeros(1 << 4, seed=99)
         return self._step(
             state,
             jnp.asarray(records, jnp.uint32),
@@ -129,6 +135,7 @@ class ShardedTelemetry:
             jnp.asarray(now_s, jnp.uint32),
             ident,
             jnp.asarray(apiserver_ip, jnp.uint32),
+            filter_map,
             jnp.asarray(lost, jnp.uint32),
         )
 

@@ -1,0 +1,244 @@
+"""Cache, filtermanager, watchers, pluginmanager tests — the reference's
+L4 unit coverage (pluginmanager_test.go lifecycle/failure tests via
+MockPlugin, cache getter/updater tests, watcher snapshot-diff tests)."""
+
+import threading
+import time
+
+import pytest
+
+from retina_tpu.common import RetinaEndpoint, RetinaSvc, TOPIC_ENDPOINTS
+from retina_tpu.config import Config
+from retina_tpu.controllers.cache import Cache
+from retina_tpu.events.schema import ip_to_u32
+from retina_tpu.exporter import reset_for_tests as reset_exporter
+from retina_tpu.managers.filtermanager import FilterManager
+from retina_tpu.managers.pluginmanager import PluginManager
+from retina_tpu.managers.watchermanager import WatcherManager
+from retina_tpu.metrics import reset_for_tests as reset_metrics
+from retina_tpu.plugins.mockplugin import MockPlugin
+from retina_tpu.pubsub import PubSub
+from retina_tpu.watchers.apiserver import ApiServerWatcher
+from retina_tpu.watchers.endpoint import EndpointWatcher
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    reset_exporter()
+    reset_metrics()
+    yield
+    MockPlugin.fail_stage = None
+
+
+def ep(name, ns="default", ips=()):
+    return RetinaEndpoint(name=name, namespace=ns, ips=tuple(ips))
+
+
+# ----------------------------------------------------------------- cache
+def test_cache_index_allocation_and_recycling():
+    c = Cache(max_pods=8)
+    i1 = c.update_endpoint(ep("a", ips=["10.0.0.1"]))
+    i2 = c.update_endpoint(ep("b", ips=["10.0.0.2"]))
+    assert i1 != i2 and i1 > 0 and i2 > 0
+    # update keeps the index
+    assert c.update_endpoint(ep("a", ips=["10.0.0.9"])) == i1
+    # old IP unmapped, new IP mapped
+    assert c.get_obj_by_ip("10.0.0.1") is None
+    assert c.get_obj_by_ip("10.0.0.9").name == "a"
+    c.delete_endpoint("default/a")
+    # freed index recycled
+    i3 = c.update_endpoint(ep("c", ips=["10.0.0.3"]))
+    assert i3 == i1
+    m = c.ip_index_map()
+    assert m[ip_to_u32("10.0.0.3")] == i3
+    assert m[ip_to_u32("10.0.0.2")] == i2
+
+
+def test_cache_exhaustion_maps_to_zero():
+    c = Cache(max_pods=3)  # indices 1, 2 usable
+    assert c.update_endpoint(ep("a")) == 1
+    assert c.update_endpoint(ep("b")) == 2
+    assert c.update_endpoint(ep("overflow")) == 0
+
+
+def test_cache_services_and_ns_counts():
+    c = Cache()
+    c.update_service(RetinaSvc(name="db", namespace="prod",
+                               cluster_ip="10.96.0.10"))
+    assert c.get_obj_by_ip("10.96.0.10").name == "db"
+    c.update_endpoint(ep("p1", ns="prod"))
+    c.update_endpoint(ep("p2", ns="prod"))
+    assert c.namespace_count("prod") == 2
+    c.delete_endpoint("prod/p1")
+    assert c.namespace_count("prod") == 1
+
+
+def test_cache_identity_change_callback():
+    c = Cache()
+    calls = []
+    c.on_identity_change(lambda: calls.append(1))
+    c.update_endpoint(ep("a", ips=["10.0.0.1"]))
+    c.delete_endpoint("default/a")
+    assert len(calls) == 2
+
+
+# --------------------------------------------------------- filtermanager
+def test_filtermanager_refcounting():
+    applied: list[set] = []
+    fm = FilterManager(apply_fn=applied.append)
+    fm.add_ips([1, 2], "watcher", "rule1")
+    fm.add_ips([2], "module", "rule2")  # no new IP -> no push
+    assert applied[-1] == {1, 2}
+    n_pushes = len(applied)
+    fm.delete_ips([2], "watcher", "rule1")  # still referenced by module
+    assert len(applied) == n_pushes
+    assert fm.has_ip(2)
+    fm.delete_ips([2], "module", "rule2")  # last ref gone
+    assert applied[-1] == {1}
+    assert not fm.has_ip(2)
+
+
+def test_filtermanager_retries_transient_failures():
+    calls = {"n": 0}
+
+    def flaky(ips):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("device busy")
+
+    fm = FilterManager(apply_fn=flaky)
+    fm.add_ips([5], "r", "1")
+    assert calls["n"] == 3
+
+
+# -------------------------------------------------------------- watchers
+def test_endpoint_watcher_diff(tmp_path):
+    net = tmp_path / "class" / "net"
+    (net / "eth0").mkdir(parents=True)
+    ps = PubSub()
+    events = []
+    done = threading.Event()
+
+    def cb(msg):
+        events.append(msg)
+        done.set()
+
+    ps.subscribe(TOPIC_ENDPOINTS, cb)
+    w = EndpointWatcher(ps, sys_root=str(tmp_path))
+    w.refresh()
+    assert done.wait(2.0)
+    assert ("created", "eth0") in events
+    (net / "veth1").mkdir()
+    done.clear()
+    w.refresh()
+    assert done.wait(2.0)
+    assert ("created", "veth1") in events
+    w.refresh()  # no change -> no new events
+    time.sleep(0.05)
+    assert len([e for e in events if e[1] == "veth1"]) == 1
+    ps.shutdown()
+
+
+def test_apiserver_watcher_resolves_and_pushes():
+    ps = PubSub()
+    fm_applied = []
+    fm = FilterManager(apply_fn=fm_applied.append)
+    pushed_ips = []
+    resolved = {"ips": ["192.168.1.1", "192.168.1.2"]}
+    w = ApiServerWatcher(
+        ps, host="apiserver.test", filtermanager=fm,
+        on_ips=pushed_ips.append, resolver=lambda h: resolved["ips"],
+    )
+    w.refresh()
+    assert fm.has_ip(ip_to_u32("192.168.1.1"))
+    assert pushed_ips[-1] == [ip_to_u32("192.168.1.1"),
+                              ip_to_u32("192.168.1.2")]
+    # IP rotation: one removed, one added
+    resolved["ips"] = ["192.168.1.2", "192.168.1.3"]
+    w.refresh()
+    assert not fm.has_ip(ip_to_u32("192.168.1.1"))
+    assert fm.has_ip(ip_to_u32("192.168.1.3"))
+    ps.shutdown()
+
+
+def test_watchermanager_isolates_watcher_errors():
+    class Boom:
+        name = "boom"
+
+        def refresh(self):
+            raise RuntimeError("no")
+
+    class Ok:
+        name = "ok"
+        n = 0
+
+        def refresh(self):
+            Ok.n += 1
+
+    wm = WatcherManager([Boom(), Ok()], interval_s=0.01)
+    stop = threading.Event()
+    wm.start(stop)
+    time.sleep(0.1)
+    stop.set()
+    assert Ok.n >= 2  # kept refreshing despite Boom failing
+
+
+# --------------------------------------------------------- pluginmanager
+def test_pluginmanager_lifecycle():
+    cfg = Config()
+    cfg.enabled_plugins = ["mock"]
+    pm = PluginManager(cfg)
+    stop = threading.Event()
+    pm.start(stop)
+    p = pm.plugins["mock"]
+    assert p.started.wait(2.0)
+    assert p.calls[:4] == ["generate", "compile", "stop", "init"]
+    stop.set()
+    pm.stop()
+    assert not pm.failed
+
+
+def test_pluginmanager_reconcile_failure_counts():
+    cfg = Config()
+    cfg.enabled_plugins = ["mock"]
+    MockPlugin.fail_stage = "compile"
+    pm = PluginManager(cfg)
+    with pytest.raises(RuntimeError):
+        pm.reconcile("mock")
+    from retina_tpu.metrics import get_metrics
+
+    v = get_metrics().plugin_reconcile_failures.labels(
+        plugin="mock"
+    )._value.get()
+    assert v == 1
+
+
+def test_pluginmanager_crash_sets_stop():
+    cfg = Config()
+    cfg.enabled_plugins = ["mock"]
+    MockPlugin.fail_stage = "start"
+    pm = PluginManager(cfg)
+    stop = threading.Event()
+    pm.start(stop)
+    assert stop.wait(2.0)  # errgroup: crash tears the agent down
+    assert pm.failed
+    assert pm.errors and pm.errors[0][0] == "mock"
+    pm.stop()
+
+
+def test_pluginmanager_unknown_plugin_fatal():
+    cfg = Config()
+    cfg.enabled_plugins = ["doesnotexist"]
+    with pytest.raises(KeyError):
+        PluginManager(cfg)
+
+
+def test_pluginmanager_conntrack_gating():
+    cfg = Config()
+    cfg.enabled_plugins = ["packetparser"]
+    pm = PluginManager(cfg)
+    assert "conntrack" in pm.plugins  # GC rides along with packetparser
+    cfg2 = Config()
+    cfg2.enabled_plugins = ["linuxutil"]
+    pm2 = PluginManager(cfg2)
+    assert "conntrack" not in pm2.plugins
