@@ -1,0 +1,88 @@
+"""Standard agent daemon: the boot sequence.
+
+Reference analog: cmd/standard/daemon.go:80-323 — Daemon.Start loads
+config, sets up zap + telemetry + metrics, builds the controller-runtime
+manager, wires pubsub/cache/enricher/filtermanager/metrics-module when
+pod-level is on (:239-295), then runs the controller manager until SIGTERM
+cancels the context and the Stop cascade runs.
+
+Here: config → logging → ControllerManager (server + engine + plugins +
+watchers) → MetricsModule (pod-level) → signal-driven stop event. The
+driver-facing entry is :func:`run_agent`; ``python -m retina_tpu`` calls
+it via the CLI.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any, Optional
+
+from retina_tpu.config import Config, load_config
+from retina_tpu.crd.types import MetricsConfiguration
+from retina_tpu.log import logger, setup_logger
+from retina_tpu.managers.controllermanager import ControllerManager
+from retina_tpu.module.metrics_module import MetricsModule
+
+
+class Daemon:
+    def __init__(self, cfg: Config, apiserver_host: str = ""):
+        self.cfg = cfg
+        self.log = logger("daemon")
+        self.cm = ControllerManager(cfg, apiserver_host=apiserver_host)
+        self.metrics_module: Optional[MetricsModule] = None
+        self._mm_thread: Optional[threading.Thread] = None
+        if cfg.enable_pod_level:
+            dns_plugin = self.cm.pluginmanager.plugins.get("dns")
+            self.metrics_module = MetricsModule(
+                cfg,
+                engine=self.cm.engine,
+                cache=self.cm.cache,
+                filtermanager=self.cm.filtermanager,
+                pubsub=self.cm.pubsub,
+                dns_resolver=(dns_plugin.resolve if dns_plugin else None),
+            )
+
+    def start(self, stop: threading.Event) -> None:
+        self.log.info(
+            "starting retina-tpu agent: plugins=%s source=%s pod_level=%s",
+            self.cfg.enabled_plugins, self.cfg.event_source,
+            self.cfg.enable_pod_level,
+        )
+        self.cm.init()
+        if self.metrics_module is not None:
+            self.metrics_module.reconcile(MetricsConfiguration.default())
+            self._mm_thread = threading.Thread(
+                target=self.metrics_module.start, args=(stop,),
+                name="metricsmodule", daemon=True,
+            )
+            self._mm_thread.start()
+        if self.cfg.snapshot_dir:
+            import os
+
+            path = os.path.join(self.cfg.snapshot_dir, "sketch_state.npz")
+            if os.path.exists(path):
+                try:
+                    self.cm.engine.load_snapshot_state(path)
+                    self.log.info("resumed sketch state from %s", path)
+                except ValueError as e:
+                    self.log.warning("stale checkpoint ignored: %s", e)
+        self.cm.start(stop)  # blocks until stop fires; runs shutdown
+
+
+def run_agent(
+    config_path: str | None = None,
+    overrides: dict[str, Any] | None = None,
+    apiserver_host: str = "",
+    install_signals: bool = True,
+) -> Daemon:
+    """Build + run the agent (blocking). SIGTERM/SIGINT → clean stop."""
+    cfg = load_config(config_path, overrides=overrides)
+    setup_logger(cfg.log_level, cfg.log_file)
+    stop = threading.Event()
+    if install_signals:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: stop.set())
+    d = Daemon(cfg, apiserver_host=apiserver_host)
+    d.start(stop)
+    return d

@@ -1,0 +1,35 @@
+"""Traces module.
+
+Reference analog: pkg/module/traces — a skeleton ModuleInterface with
+``Reconcile(*TracesSpec)`` only (traces_module.go), kept as a stub for a
+future trace pipeline. Parity stub here: accepts TracesConfiguration
+reconciles and records the active spec; the TPU trace story (jax.profiler
+device traces) hangs off /debug/pprof instead.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from retina_tpu.crd.types import TracesConfiguration, TracesSpec
+from retina_tpu.log import logger
+
+
+class TracesModule:
+    def __init__(self) -> None:
+        self._log = logger("tracesmodule")
+        self._lock = threading.Lock()
+        self._spec: TracesSpec | None = None
+
+    def reconcile(self, conf: TracesConfiguration) -> None:
+        with self._lock:
+            self._spec = conf.spec
+        self._log.info(
+            "traces spec accepted (%d targets; trace pipeline not yet "
+            "implemented, matching the reference stub)",
+            len(conf.spec.trace_targets),
+        )
+
+    def active_spec(self) -> TracesSpec | None:
+        with self._lock:
+            return self._spec
