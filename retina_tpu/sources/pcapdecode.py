@@ -99,8 +99,73 @@ def decode_pcap_bytes(
     data: bytes,
     obs_point: int = OP_FROM_NETWORK,
     parse_dns: bool = True,
+    prefer_native: bool = True,
 ) -> PcapDecodeResult:
-    """Decode a pcap byte string into event records (vectorized)."""
+    """Decode a pcap byte string into event records.
+
+    Uses the C++ native decoder (retina_tpu.native, bit-identical) when
+    built, falling back to the vectorized numpy path below. DNS name
+    strings always come from a sparse host-Python pass (strings never
+    enter the record tensor)."""
+    if prefer_native:
+        try:
+            from retina_tpu.native import decode_pcap_native
+
+            res = decode_pcap_native(data, obs_point)
+        except ValueError:
+            raise
+        except Exception:
+            res = None
+        if res is not None:
+            records, n_total = res
+            names = _dns_name_pass(data) if parse_dns else {}
+            return PcapDecodeResult(records, names, n_total, len(records))
+    return _decode_pcap_numpy(data, obs_point, parse_dns)
+
+
+def _dns_name_pass(data: bytes) -> dict[int, str]:
+    """Sparse second pass: qname strings for UDP:53 packets only."""
+    if len(data) < 24:
+        return {}
+    magic = struct.unpack_from("<I", data, 0)[0]
+    if magic in (PCAP_MAGIC_US, PCAP_MAGIC_NS):
+        swap, ns = False, magic == PCAP_MAGIC_NS
+    else:
+        magic_be = struct.unpack_from(">I", data, 0)[0]
+        if magic_be not in (PCAP_MAGIC_US, PCAP_MAGIC_NS):
+            return {}
+        swap, ns = True, magic_be == PCAP_MAGIC_NS
+    _, pkt_off, caplen = _find_offsets(data, ns, swap)
+    names: dict[int, str] = {}
+    for off, incl in zip(pkt_off, caplen):
+        off, incl = int(off), int(incl)
+        if incl < 14 + 20 + 8:
+            continue
+        if data[off + 12] != 0x08 or data[off + 13] != 0x00:
+            continue
+        ip_off = off + 14
+        if (data[ip_off] >> 4) != 4 or data[ip_off + 9] != PROTO_UDP:
+            continue
+        ihl = (data[ip_off] & 0xF) * 4
+        l4 = ip_off + ihl
+        if incl < 14 + ihl + 8:
+            continue
+        sport = (data[l4] << 8) | data[l4 + 1]
+        dport = (data[l4 + 2] << 8) | data[l4 + 3]
+        if sport != 53 and dport != 53:
+            continue
+        parsed = _parse_dns(data, l4 + 8, off + incl)
+        if parsed is not None:
+            names[dns_qname_hash(parsed[0])] = parsed[0]
+    return names
+
+
+def _decode_pcap_numpy(
+    data: bytes,
+    obs_point: int = OP_FROM_NETWORK,
+    parse_dns: bool = True,
+) -> PcapDecodeResult:
+    """Pure numpy reference decoder (vectorized)."""
     if len(data) < 24:
         return PcapDecodeResult(
             np.zeros((0, NUM_FIELDS), np.uint32), {}, 0, 0
@@ -152,8 +217,9 @@ def decode_pcap_bytes(
     dport = np.where(ok, _gather_u16(buf, safe_l4 + 2), 0)
 
     is_tcp = ok & (proto == PROTO_TCP)
-    tcp_flags = np.where(is_tcp, _gather_u8(buf, safe_l4 + 13), 0)
-    doff = np.where(is_tcp, (_gather_u8(buf, safe_l4 + 12) >> 4) * 4, 8)
+    tcp_at = np.where(is_tcp, safe_l4, 0)  # UDP rows may sit at buffer end
+    tcp_flags = np.where(is_tcp, _gather_u8(buf, tcp_at + 13), 0)
+    doff = np.where(is_tcp, (_gather_u8(buf, tcp_at + 12) >> 4) * 4, 8)
 
     # --- TCP timestamp option (packetparser.c:42-115): walk option
     # bytes for all TCP packets at once, at most 40 lock-step steps.

@@ -1,0 +1,134 @@
+"""Native C++ component tests: build, decoder bit-equivalence vs the numpy
+reference, shm ring semantics (SPSC, drop-and-count, cross-process attach).
+
+The reference's analog coverage is its bpf2go-generated stubs being
+exercised through plugin tests; here the contract is exact equality with
+the Python reference decoder on the same bytes."""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from retina_tpu.events.schema import NUM_FIELDS, PROTO_TCP, PROTO_UDP
+from retina_tpu.sources.pcapdecode import (
+    _decode_pcap_numpy,
+    decode_pcap_bytes,
+    synthesize_pcap,
+)
+
+native = pytest.importorskip("retina_tpu.native")
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native toolchain unavailable"
+)
+
+
+def _mixed_pcap(n=500, ns=True):
+    pkts = []
+    for i in range(n):
+        p = dict(
+            src_ip=0x0A000000 + i % 40, dst_ip=0x0A000100 + i % 11,
+            sport=1024 + i, dport=[80, 443, 53, 8080][i % 4],
+            proto=PROTO_TCP if i % 3 else PROTO_UDP,
+            ts_ns=1_700_000_000_000_000_000 + i * 12345,
+            tcp_flags=[0x10, 0x02, 0x11, 0x04][i % 4],
+        )
+        if i % 5 == 0:
+            p["tsval"], p["tsecr"] = 1000 + i, 500 + i
+        if i % 7 == 0:
+            p.update(proto=PROTO_UDP, dport=53,
+                     dns_qname=f"svc-{i % 13}.cluster.local",
+                     dns_qtype=[1, 28, 5][i % 3],
+                     dns_response=bool(i % 2), dns_rcode=i % 4)
+        pkts.append(p)
+    return synthesize_pcap(pkts, ns=ns)
+
+
+@pytest.mark.parametrize("ns", [True, False])
+def test_decoder_bit_equivalence(ns):
+    data = _mixed_pcap(500, ns=ns)
+    ref = _decode_pcap_numpy(data)
+    records, total = native.decode_pcap_native(data)
+    assert total == ref.n_packets_total
+    assert len(records) == ref.n_decoded
+    np.testing.assert_array_equal(records, ref.records)
+
+
+def test_decode_pcap_bytes_uses_native_with_names():
+    data = _mixed_pcap(100)
+    res = decode_pcap_bytes(data, prefer_native=True)
+    ref = _decode_pcap_numpy(data)
+    np.testing.assert_array_equal(res.records, ref.records)
+    assert res.dns_names == ref.dns_names
+    assert res.dns_names  # non-empty table
+
+
+def test_native_rejects_garbage():
+    with pytest.raises(ValueError):
+        native.decode_pcap_native(b"\x00" * 128)
+
+
+# ------------------------------------------------------------------- ring
+def test_ring_push_pop_and_drop_accounting():
+    r = native.NativeRing(capacity=8)
+    rec = np.arange(5 * NUM_FIELDS, dtype=np.uint32).reshape(5, NUM_FIELDS)
+    assert r.push(rec) == 5
+    assert len(r) == 5
+    # overflow: only 3 free slots
+    assert r.push(rec) == 3
+    assert r.dropped == 2
+    out = r.pop(100)
+    assert len(out) == 8
+    np.testing.assert_array_equal(out[:5], rec)
+    np.testing.assert_array_equal(out[5:], rec[:3])
+    assert len(r) == 0
+    r.close()
+
+
+def test_ring_wraparound():
+    r = native.NativeRing(capacity=4)
+    for i in range(10):
+        rec = np.full((3, NUM_FIELDS), i, np.uint32)
+        assert r.push(rec) == 3
+        out = r.pop(10)
+        np.testing.assert_array_equal(out, rec)
+    r.close()
+
+
+def test_ring_bad_capacity():
+    with pytest.raises(ValueError):
+        native.NativeRing(capacity=100)  # not a power of two
+
+
+def _producer(path: str, n_blocks: int) -> None:
+    from retina_tpu.native import NativeRing
+
+    ring = NativeRing(capacity=1 << 12, path=path, create=False)
+    for i in range(n_blocks):
+        rec = np.full((64, NUM_FIELDS), i, np.uint32)
+        while ring.push(rec) < 64:
+            pass  # retry in the test producer (the agent never would)
+    ring.close()
+
+
+def test_ring_cross_process(tmp_path):
+    path = str(tmp_path / "ring.shm")
+    ring = native.NativeRing(capacity=1 << 12, path=path, create=True)
+    p = multiprocessing.Process(target=_producer, args=(path, 50))
+    p.start()
+    got = 0
+    import time
+
+    deadline = time.monotonic() + 15
+    while got < 50 * 64 and time.monotonic() < deadline:
+        out = ring.pop(1024)
+        got += len(out)
+        if not len(out):
+            time.sleep(0.002)
+    p.join(5)
+    assert got == 50 * 64
+    assert ring.dropped == 0
+    ring.close()
+    os.unlink(path)
