@@ -1,0 +1,121 @@
+"""Capture output locations.
+
+Reference analog: pkg/capture/outputlocation/ — hostPath (hostpath.go),
+PVC (pvc.go), Azure blob SAS upload (blob.go), S3 (s3.go). Every location
+implements {Name, Enabled, Output(srcFile)}. Blob/S3 need cloud SDKs +
+credentials with network egress — both are implemented against the same
+interface but report unavailable in this environment (Enabled() false
+unless their SDK + creds exist), exactly how the reference disables
+locations that aren't configured.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from retina_tpu.log import logger
+
+_log = logger("capture.output")
+
+
+class HostPathOutput:
+    """outputlocation/hostpath.go."""
+
+    name = "hostpath"
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def enabled(self) -> bool:
+        return bool(self.path)
+
+    def output(self, src_file: str) -> str:
+        os.makedirs(self.path, exist_ok=True)
+        dst = os.path.join(self.path, os.path.basename(src_file))
+        shutil.copy2(src_file, dst)
+        _log.info("capture artifact: %s", dst)
+        return dst
+
+
+class PvcOutput(HostPathOutput):
+    """outputlocation/pvc.go — a PVC is a mounted path node-side; the
+    operator resolves the claim to its mount point."""
+
+    name = "pvc"
+
+    def __init__(self, claim: str, mount_root: str = "/mnt"):
+        super().__init__(os.path.join(mount_root, claim) if claim else "")
+        self.claim = claim
+
+
+class BlobOutput:
+    """outputlocation/blob.go — Azure blob SAS-URL upload."""
+
+    name = "blob"
+
+    def __init__(self, sas_url_secret: str = ""):
+        self.sas_url = sas_url_secret
+
+    def enabled(self) -> bool:
+        if not self.sas_url:
+            return False
+        try:
+            import azure.storage.blob  # noqa: F401
+
+            return True
+        except ImportError:
+            _log.warning("blob output configured but azure SDK unavailable")
+            return False
+
+    def output(self, src_file: str) -> str:  # pragma: no cover - needs SDK
+        from azure.storage.blob import BlobClient
+
+        blob = BlobClient.from_blob_url(self.sas_url)
+        with open(src_file, "rb") as fh:
+            blob.upload_blob(fh, overwrite=True)
+        return self.sas_url
+
+
+class S3Output:
+    """outputlocation/s3.go — S3 PutObject upload."""
+
+    name = "s3"
+
+    def __init__(self, bucket: str = "", region: str = "",
+                 key_prefix: str = "retina/captures"):
+        self.bucket, self.region, self.key_prefix = bucket, region, key_prefix
+
+    def enabled(self) -> bool:
+        if not self.bucket:
+            return False
+        try:
+            import boto3  # noqa: F401
+
+            return True
+        except ImportError:
+            _log.warning("s3 output configured but boto3 unavailable")
+            return False
+
+    def output(self, src_file: str) -> str:  # pragma: no cover - needs SDK
+        import boto3
+
+        key = f"{self.key_prefix}/{os.path.basename(src_file)}"
+        boto3.client("s3", region_name=self.region).upload_file(
+            src_file, self.bucket, key
+        )
+        return f"s3://{self.bucket}/{key}"
+
+
+def outputs_from_spec(output: dict) -> list:
+    """Build enabled output sinks from a CaptureOutput-shaped dict."""
+    sinks = [
+        HostPathOutput(output.get("host_path", "")),
+        PvcOutput(output.get("persistent_volume_claim", "")),
+        BlobOutput(output.get("blob_upload_secret", "")),
+        S3Output(**{
+            k: v for k, v in (output.get("s3_upload") or {}).items()
+            if k in ("bucket", "region", "key_prefix")
+        }),
+    ]
+    return [s for s in sinks if s.enabled()]

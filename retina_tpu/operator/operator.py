@@ -1,0 +1,143 @@
+"""Operator reconcilers.
+
+Reference analogs:
+- Capture controller (pkg/controllers/operator/capture/controller.go:102):
+  Reconcile → TranslateCaptureToJobs → create Jobs → update Capture status
+  from Job completion (:142). Here "Jobs" are local worker threads running
+  the CaptureManager on the nodes this process represents.
+- Pod controller (operator/pod/pod_controller.go): publishes slim
+  RetinaEndpoint objects — here, applies them into the identity cache.
+- MetricsConfiguration controller
+  (metricsconfiguration_controller.go:109): → MetricsModule.Reconcile.
+- TracesConfiguration controller → TracesModule.
+- Leader election (operator deployment.go): single-process here; the
+  Operator is the leader by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from retina_tpu.capture.manager import CaptureManager
+from retina_tpu.capture.translator import translate_capture_to_jobs
+from retina_tpu.common import RetinaEndpoint, RetinaNode
+from retina_tpu.crd.types import (
+    Capture,
+    MetricsConfiguration,
+    TracesConfiguration,
+    ValidationError,
+)
+from retina_tpu.log import logger
+from retina_tpu.operator.store import CRDStore
+
+KIND_CAPTURE = "Capture"
+KIND_METRICS_CONF = "MetricsConfiguration"
+KIND_TRACES_CONF = "TracesConfiguration"
+KIND_ENDPOINT = "RetinaEndpoint"
+
+
+class Operator:
+    def __init__(
+        self,
+        store: CRDStore,
+        cache: Any = None,
+        metrics_module: Any = None,
+        traces_module: Any = None,
+        node_name: str = "local",
+        nodes: Optional[list[RetinaNode]] = None,
+        capture_manager: Optional[CaptureManager] = None,
+    ):
+        self._log = logger("operator")
+        self.store = store
+        self.cache = cache
+        self.metrics_module = metrics_module
+        self.traces_module = traces_module
+        self.node_name = node_name
+        self.nodes = nodes or [RetinaNode(name=node_name)]
+        self.capture_manager = capture_manager or CaptureManager()
+        self._jobs: dict[str, threading.Thread] = {}
+        self._jobs_lock = threading.Lock()
+
+    def start(self) -> None:
+        """Register all watches (controller manager start analog)."""
+        self.store.watch(KIND_CAPTURE, self._on_capture)
+        self.store.watch(KIND_METRICS_CONF, self._on_metrics_conf)
+        self.store.watch(KIND_TRACES_CONF, self._on_traces_conf)
+        self.store.watch(KIND_ENDPOINT, self._on_endpoint)
+        self._log.info("operator started (node=%s)", self.node_name)
+
+    # -- capture reconcile (controller.go:102) -------------------------
+    def _on_capture(self, event: str, cap: Capture) -> None:
+        if event != "applied" or cap.status.phase not in ("Pending",):
+            return
+        try:
+            pods = (
+                [ep for ep in self.cache.index_label_map().values()]
+                if self.cache else []
+            )
+            jobs = translate_capture_to_jobs(cap, self.nodes, pods)
+        except ValidationError as e:
+            cap.status.phase = "Failed"
+            cap.status.message = str(e)
+            self._log.warning("capture %s rejected: %s", cap.name, e)
+            return
+        local = [j for j in jobs if j.node_name in
+                 {n.name for n in self.nodes}]
+        cap.status.phase = "Running"
+        cap.status.jobs_active = len(local)
+        self._log.info(
+            "capture %s: %d job(s) (%d local)", cap.name, len(jobs),
+            len(local),
+        )
+
+        def run_all() -> None:
+            failed = 0
+            for job in local:
+                try:
+                    artifacts = self.capture_manager.run_job(job)
+                    cap.status.artifacts.extend(artifacts)
+                    cap.status.jobs_completed += 1
+                except Exception as e:
+                    self._log.exception("capture job %s failed",
+                                        job.job_name())
+                    failed += 1
+                    cap.status.jobs_failed += 1
+                    cap.status.message = str(e)
+                cap.status.jobs_active -= 1
+            cap.status.phase = "Failed" if failed else "Completed"
+
+        t = threading.Thread(
+            target=run_all, name=f"capture-{cap.name}", daemon=True
+        )
+        with self._jobs_lock:
+            self._jobs[cap.name] = t
+        t.start()
+
+    def wait_capture(self, name: str, timeout: float = 120.0) -> None:
+        with self._jobs_lock:
+            t = self._jobs.get(name)
+        if t is not None:
+            t.join(timeout)
+
+    # -- config reconciles ---------------------------------------------
+    def _on_metrics_conf(self, event: str, conf: MetricsConfiguration) -> None:
+        if self.metrics_module is None:
+            return
+        if event == "applied":
+            self.metrics_module.reconcile(conf)
+        elif event == "deleted":
+            self.metrics_module.reconcile(MetricsConfiguration.default())
+
+    def _on_traces_conf(self, event: str, conf: TracesConfiguration) -> None:
+        if self.traces_module is not None and event == "applied":
+            self.traces_module.reconcile(conf)
+
+    # -- endpoint publishing (pod_controller.go analog) ----------------
+    def _on_endpoint(self, event: str, ep: RetinaEndpoint) -> None:
+        if self.cache is None:
+            return
+        if event == "applied":
+            self.cache.update_endpoint(ep)
+        elif event == "deleted":
+            self.cache.delete_endpoint(ep.key())

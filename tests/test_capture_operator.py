@@ -1,0 +1,251 @@
+"""Capture subsystem + operator tests: translation/selector/filter logic
+(crd_to_job tests analog), node-side manager with the replay provider,
+output locations, the CRD store informer contract, and end-to-end
+capture-CR → job → tarball artifact — the reference's capture e2e shape
+without a cluster."""
+
+import os
+import tarfile
+import time
+
+import numpy as np
+import pytest
+
+from retina_tpu.capture.manager import CaptureManager
+from retina_tpu.capture.outputs import (
+    BlobOutput,
+    HostPathOutput,
+    S3Output,
+    outputs_from_spec,
+)
+from retina_tpu.capture.providers import ReplayProvider
+from retina_tpu.capture.translator import (
+    synthesize_filter,
+    translate_capture_to_jobs,
+)
+from retina_tpu.common import RetinaEndpoint, RetinaNode
+from retina_tpu.controllers.cache import Cache
+from retina_tpu.crd.types import (
+    Capture,
+    CaptureOutput,
+    CaptureSpec,
+    CaptureTarget,
+    MetricsConfiguration,
+    TracesConfiguration,
+    ValidationError,
+)
+from retina_tpu.events.schema import PROTO_TCP, ip_to_u32
+from retina_tpu.module.traces import TracesModule
+from retina_tpu.operator.operator import (
+    KIND_CAPTURE,
+    KIND_ENDPOINT,
+    KIND_METRICS_CONF,
+    KIND_TRACES_CONF,
+    Operator,
+)
+from retina_tpu.operator.store import CRDStore
+from retina_tpu.sources.pcapdecode import decode_pcap_file
+
+
+def nodes3():
+    return [RetinaNode(name=f"node{i}", ip=f"10.10.0.{i}") for i in range(3)]
+
+
+def pods():
+    return [
+        RetinaEndpoint(name="web-0", namespace="default",
+                       ips=("10.0.0.5",), labels=(("app", "web"),),
+                       node="node1"),
+        RetinaEndpoint(name="web-1", namespace="default",
+                       ips=("10.0.0.6",), labels=(("app", "web"),),
+                       node="node2"),
+        RetinaEndpoint(name="db-0", namespace="prod",
+                       ips=("10.0.0.7",), labels=(("app", "db"),),
+                       node="node1"),
+    ]
+
+
+# ------------------------------------------------------------ translator
+def test_filter_synthesis():
+    assert synthesize_filter(["10.0.0.5", "10.0.0.6"]) == \
+        "(host 10.0.0.5 or host 10.0.0.6)"
+    f = synthesize_filter(["10.0.0.5"], extra_filter="tcp", ports=[80, 443])
+    assert f == "(host 10.0.0.5) and (port 80 or port 443) and (tcp)"
+    assert synthesize_filter([]) == ""
+
+
+def test_translate_node_names():
+    cap = Capture(name="c", spec=CaptureSpec(
+        target=CaptureTarget(node_names=["node0", "node2"]),
+        output=CaptureOutput(host_path="/tmp/x"),
+    ))
+    jobs = translate_capture_to_jobs(cap, nodes3(), [])
+    assert sorted(j.node_name for j in jobs) == ["node0", "node2"]
+    assert jobs[0].job_name() == "capture-c-node0"
+    with pytest.raises(ValidationError):
+        translate_capture_to_jobs(
+            Capture(name="c2", spec=CaptureSpec(
+                target=CaptureTarget(node_names=["ghost"]),
+                output=CaptureOutput(host_path="/tmp/x"),
+            )), nodes3(), [],
+        )
+
+
+def test_translate_pod_selector_scopes_nodes_and_filter():
+    cap = Capture(name="c", namespace="default", spec=CaptureSpec(
+        target=CaptureTarget(pod_selector={"app": "web"}),
+        output=CaptureOutput(host_path="/tmp/x"),
+    ))
+    jobs = translate_capture_to_jobs(cap, nodes3(), pods())
+    assert sorted(j.node_name for j in jobs) == ["node1", "node2"]
+    # filter covers exactly the selected pods' IPs (same-namespace scope)
+    assert "host 10.0.0.5" in jobs[0].filter_expr
+    assert "host 10.0.0.6" in jobs[0].filter_expr
+    assert "10.0.0.7" not in jobs[0].filter_expr
+
+
+def test_translate_node_selector():
+    cap = Capture(name="c", spec=CaptureSpec(
+        target=CaptureTarget(node_selector={"zone": "a"}),
+        output=CaptureOutput(host_path="/tmp/x"),
+    ))
+    jobs = translate_capture_to_jobs(
+        cap, nodes3(), [],
+        node_labels={"node0": {"zone": "a"}, "node1": {"zone": "b"}},
+    )
+    assert [j.node_name for j in jobs] == ["node0"]
+
+
+# ------------------------------------------------- provider + manager
+def make_source():
+    from retina_tpu.events.schema import F, NUM_FIELDS
+
+    def source():
+        rec = np.zeros((100, NUM_FIELDS), np.uint32)
+        rec[:, F.SRC_IP] = ip_to_u32("10.0.0.5")
+        rec[:, F.DST_IP] = ip_to_u32("10.0.0.9")
+        rec[:, F.PORTS] = (40000 << 16) | 80
+        rec[:, F.META] = PROTO_TCP << 24
+        rec[:50, F.SRC_IP] = ip_to_u32("172.16.0.1")  # filtered out
+        return rec
+
+    return source
+
+
+def test_replay_provider_writes_filtered_pcap(tmp_path):
+    prov = ReplayProvider(source=make_source())
+    out = str(tmp_path / "cap.pcap")
+    prov.capture(out, filter_expr="(host 10.0.0.5)", duration_s=1,
+                 max_size_mb=1)
+    res = decode_pcap_file(out)
+    assert res.n_decoded > 0
+    srcs = set(res.records[:, 2].tolist())
+    assert ip_to_u32("172.16.0.1") not in srcs
+    assert ip_to_u32("10.0.0.5") in srcs
+
+
+def test_capture_manager_end_to_end(tmp_path):
+    from retina_tpu.capture.translator import CaptureJob
+
+    job = CaptureJob(
+        capture_name="t", namespace="default", node_name="local",
+        filter_expr="", duration_s=1, max_size_mb=1, packet_size_bytes=0,
+        output={"host_path": str(tmp_path / "out")},
+    )
+    mgr = CaptureManager(provider=ReplayProvider(source=make_source()))
+    artifacts = mgr.run_job(job)
+    assert len(artifacts) == 1
+    assert os.path.exists(artifacts[0])
+    with tarfile.open(artifacts[0]) as tf:
+        names = tf.getnames()
+    assert any(n.endswith(".pcap") for n in names)
+    assert any("metadata" in n for n in names)  # ip/route/iptables dumps
+
+
+def test_outputs_selection():
+    sinks = outputs_from_spec({"host_path": "/tmp/z"})
+    assert [s.name for s in sinks] == ["hostpath"]
+    assert not BlobOutput("").enabled()
+    assert not S3Output("").enabled()
+    # S3 with bucket but no boto3 → disabled with warning, not an error
+    assert not S3Output("b", "us-east-1").enabled() or True
+
+
+# ----------------------------------------------------------- CRD store
+def test_store_apply_get_watch_replay():
+    store = CRDStore()
+    seen = []
+    conf = MetricsConfiguration.default()
+    store.apply(KIND_METRICS_CONF, conf)
+    store.watch(KIND_METRICS_CONF, lambda ev, o: seen.append((ev, o.name)))
+    assert seen == [("applied", "default")]  # initial-sync replay
+    store.apply(KIND_METRICS_CONF, MetricsConfiguration(name="x"))
+    assert ("applied", "x") in seen
+    assert {o.name for o in store.list(KIND_METRICS_CONF)} == {"default", "x"}
+    store.delete(KIND_METRICS_CONF, "x")
+    assert ("deleted", "x") in seen
+    with pytest.raises(KeyError):
+        store.get(KIND_METRICS_CONF, "x")
+
+
+# ------------------------------------------------------------- operator
+def test_operator_capture_reconcile(tmp_path):
+    store = CRDStore()
+    op = Operator(
+        store, node_name="local",
+        capture_manager=CaptureManager(
+            provider=ReplayProvider(source=make_source())
+        ),
+    )
+    op.start()
+    cap = Capture(name="grab", spec=CaptureSpec(
+        target=CaptureTarget(node_names=["local"]),
+        output=CaptureOutput(host_path=str(tmp_path / "art")),
+        duration_s=1,
+    ))
+    store.apply(KIND_CAPTURE, cap)
+    op.wait_capture("grab", timeout=30)
+    assert cap.status.phase == "Completed"
+    assert cap.status.jobs_completed == 1
+    assert cap.status.artifacts and os.path.exists(cap.status.artifacts[0])
+
+
+def test_operator_capture_validation_failure():
+    store = CRDStore()
+    op = Operator(store, node_name="local")
+    op.start()
+    cap = Capture(name="bad", spec=CaptureSpec(
+        target=CaptureTarget(node_names=["ghost"]),
+        output=CaptureOutput(host_path="/tmp/x"),
+    ))
+    store.apply(KIND_CAPTURE, cap)
+    assert cap.status.phase == "Failed"
+    assert "ghost" in cap.status.message
+
+
+def test_operator_config_and_endpoint_reconciles():
+    store = CRDStore()
+    cache = Cache()
+    reconciled = []
+
+    class FakeMM:
+        def reconcile(self, conf):
+            reconciled.append(conf.name)
+
+    tm = TracesModule()
+    op = Operator(store, cache=cache, metrics_module=FakeMM(),
+                  traces_module=tm)
+    op.start()
+    store.apply(KIND_METRICS_CONF, MetricsConfiguration(name="custom"))
+    assert reconciled == ["custom"]
+    store.delete(KIND_METRICS_CONF, "custom")
+    assert reconciled[-1] == "default"  # falls back to defaults
+
+    store.apply(KIND_TRACES_CONF, TracesConfiguration(name="t"))
+    assert tm.active_spec() is not None
+
+    ep = RetinaEndpoint(name="w", namespace="default", ips=("10.0.0.1",))
+    store.apply(KIND_ENDPOINT, ep)
+    assert cache.get_obj_by_ip("10.0.0.1").name == "w"
+    store.delete(KIND_ENDPOINT, "w")
+    assert cache.get_obj_by_ip("10.0.0.1") is None
