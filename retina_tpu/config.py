@@ -45,6 +45,9 @@ class Config:
     bypass_lookup_ip_of_interest: bool = False
     data_aggregation_level: str = AGG_LOW
     telemetry_interval_s: float = 900.0
+    enable_hubble: bool = False  # flow-relay control plane (cmd/hubble)
+    hubble_addr: str = "127.0.0.1:4244"
+    hubble_ring_capacity: int = 1 << 12
     log_level: str = "info"
     log_file: str = ""  # empty = stderr only
 

@@ -32,6 +32,29 @@ class Daemon:
         self.cm = ControllerManager(cfg, apiserver_host=apiserver_host)
         self.metrics_module: Optional[MetricsModule] = None
         self._mm_thread: Optional[threading.Thread] = None
+        self.hubble = None
+        self.monitoragent = None
+        if cfg.enable_hubble:
+            # Hubble CP rides alongside (cmd/hubble cell graph analog):
+            # plugins mirror events into the external channel; the monitor
+            # agent fans them out to the flow observer; the gRPC relay
+            # serves GetFlows (SURVEY.md §3.5).
+            from retina_tpu.hubble import (
+                FlowObserver,
+                HubbleServer,
+                MonitorAgent,
+            )
+
+            self.monitoragent = MonitorAgent()
+            dns_plugin = self.cm.pluginmanager.plugins.get("dns")
+            self.observer = FlowObserver(
+                capacity=cfg.hubble_ring_capacity,
+                cache=self.cm.cache,
+                dns_resolver=(dns_plugin.resolve if dns_plugin else None),
+            )
+            self.monitoragent.register_consumer(self.observer.consume)
+            self.cm.pluginmanager.setup_channel(self.monitoragent.channel)
+            self.hubble = HubbleServer(self.observer, addr=cfg.hubble_addr)
         if cfg.enable_pod_level:
             dns_plugin = self.cm.pluginmanager.plugins.get("dns")
             self.metrics_module = MetricsModule(
@@ -50,6 +73,10 @@ class Daemon:
             self.cfg.enable_pod_level,
         )
         self.cm.init()
+        if self.monitoragent is not None:
+            self.monitoragent.start(stop)
+        if self.hubble is not None:
+            self.hubble.start()
         if self.metrics_module is not None:
             self.metrics_module.reconcile(MetricsConfiguration.default())
             self._mm_thread = threading.Thread(
@@ -67,7 +94,11 @@ class Daemon:
                     self.log.info("resumed sketch state from %s", path)
                 except ValueError as e:
                     self.log.warning("stale checkpoint ignored: %s", e)
-        self.cm.start(stop)  # blocks until stop fires; runs shutdown
+        try:
+            self.cm.start(stop)  # blocks until stop fires; runs shutdown
+        finally:
+            if self.hubble is not None:
+                self.hubble.stop()
 
 
 def run_agent(

@@ -1,0 +1,82 @@
+"""Flow observer: bounded flow ring with follow readers.
+
+Reference analog: the Hubble observer's ring buffer of decoded flows that
+``GetFlows`` serves, with follow semantics (new flows stream as they
+arrive) — the same structure the enricher uses internally (Cilium
+container.Ring, enricher.go:45-52: bounded, overwrite-oldest, per-reader
+cursors that observe loss rather than block the writer).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+from retina_tpu.hubble.flow import FlowFilter, record_to_flow
+from retina_tpu.log import logger
+
+
+class FlowObserver:
+    def __init__(self, capacity: int = 4096, cache: Any = None,
+                 dns_resolver: Any = None):
+        assert capacity & (capacity - 1) == 0
+        self._log = logger("observer")
+        self._cap = capacity
+        self._ring: list[Optional[dict]] = [None] * capacity
+        self._seq = 0  # total flows ever written
+        self._lock = threading.Condition()
+        self.cache = cache
+        self.dns_resolver = dns_resolver
+        self.flows_seen = 0
+
+    # -- writer side (monitoragent consumer) ---------------------------
+    def consume(self, records: np.ndarray) -> None:
+        flows = [
+            record_to_flow(rec, self.cache, self.dns_resolver)
+            for rec in records
+        ]
+        with self._lock:
+            for f in flows:
+                self._ring[self._seq & (self._cap - 1)] = f
+                self._seq += 1
+            self.flows_seen = self._seq
+            self._lock.notify_all()
+
+    # -- reader side ---------------------------------------------------
+    def get_flows(
+        self,
+        filter: Optional[FlowFilter] = None,
+        last: int = 0,
+        follow: bool = False,
+        stop: Optional[threading.Event] = None,
+        timeout_s: float = 30.0,
+    ) -> Iterator[dict[str, Any]]:
+        """Yield flows: the most recent ``last`` (0 = all buffered), then
+        keep following if requested. A slow reader skips overwritten
+        entries (loss over blocking, like every ring in this system)."""
+        with self._lock:
+            end0 = self._seq
+            window = min(end0, self._cap, last if last else self._cap)
+            cursor = end0 - window
+        while True:
+            with self._lock:
+                if cursor < self._seq - self._cap:
+                    cursor = self._seq - self._cap  # fell behind: skip
+                limit = self._seq if follow else end0
+                batch = []
+                while cursor < limit:
+                    f = self._ring[cursor & (self._cap - 1)]
+                    cursor += 1
+                    if f is not None:
+                        batch.append(f)
+                if not batch and follow:
+                    self._lock.wait(timeout=0.2)
+            for f in batch:
+                if filter is None or filter.matches(f):
+                    yield f
+            if not follow and cursor >= end0:
+                return
+            if stop is not None and stop.is_set():
+                return

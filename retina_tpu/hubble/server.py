@@ -1,0 +1,177 @@
+"""Hubble gRPC flow relay: Observer + Peer services.
+
+Reference analog: pkg/hubble/hubble_linux.go — the Retina-flavored Hubble
+server exposing the flow gRPC API on :4244 (relay) and a peer service for
+node discovery, plus hubble_* self metrics. Services here are registered
+via gRPC generic handlers with msgpack frames (the image lacks
+protoc-gen-grpc; the transport is still gRPC/HTTP2 server-streaming, so a
+relay client's connection semantics are preserved).
+
+API (service retina.Observer):
+- GetFlows(request) → stream of flow dicts; request: {"filter": {...},
+  "last": N, "follow": bool}
+- ServerStatus({}) → {"num_flows", "max_flows", "seen_flows", "uptime_ns"}
+service retina.Peer:
+- ListPeers({}) → {"peers": [{"name", "address"}]}
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+from typing import Any, Iterator, Optional
+
+import grpc
+import msgpack
+
+from retina_tpu.hubble.flow import FlowFilter
+from retina_tpu.hubble.observer import FlowObserver
+from retina_tpu.log import logger
+
+_pack = lambda obj: msgpack.packb(obj, use_bin_type=True)
+_unpack = lambda raw: msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+OBSERVER_SERVICE = "retina.Observer"
+PEER_SERVICE = "retina.Peer"
+
+
+class HubbleServer:
+    def __init__(
+        self,
+        observer: FlowObserver,
+        addr: str = "127.0.0.1:4244",
+        peers: Optional[list[dict[str, str]]] = None,
+        max_workers: int = 8,
+    ):
+        self._log = logger("hubble")
+        self.observer = observer
+        self.addr = addr
+        self.peers = peers or []
+        self._t0 = time.time_ns()
+        self._stop = threading.Event()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._server.add_generic_rpc_handlers([self._make_handlers()])
+        self.port = self._server.add_insecure_port(addr)
+
+    # -- service implementation ---------------------------------------
+    def _get_flows(self, request: bytes, ctx) -> Iterator[bytes]:
+        req = _unpack(request) if request else {}
+        filt = (
+            FlowFilter.from_dict(req["filter"]) if req.get("filter") else None
+        )
+        stop = threading.Event()
+        ctx.add_callback(stop.set)
+
+        def gen():
+            for flow in self.observer.get_flows(
+                filter=filt,
+                last=int(req.get("last", 0)),
+                follow=bool(req.get("follow", False)),
+                stop=stop,
+            ):
+                if stop.is_set():
+                    return
+                yield _pack(flow)
+
+        return gen()
+
+    def _server_status(self, request: bytes, ctx) -> bytes:
+        return _pack(
+            {
+                "num_flows": min(self.observer.flows_seen,
+                                 self.observer._cap),
+                "max_flows": self.observer._cap,
+                "seen_flows": self.observer.flows_seen,
+                "uptime_ns": time.time_ns() - self._t0,
+            }
+        )
+
+    def _list_peers(self, request: bytes, ctx) -> bytes:
+        return _pack({"peers": self.peers})
+
+    def _make_handlers(self):
+        bypass = lambda x: x  # already-packed bytes
+        observer = grpc.method_handlers_generic_handler(
+            OBSERVER_SERVICE,
+            {
+                "GetFlows": grpc.unary_stream_rpc_method_handler(
+                    self._get_flows,
+                    request_deserializer=bypass,
+                    response_serializer=bypass,
+                ),
+                "ServerStatus": grpc.unary_unary_rpc_method_handler(
+                    self._server_status,
+                    request_deserializer=bypass,
+                    response_serializer=bypass,
+                ),
+            },
+        )
+        peer = grpc.method_handlers_generic_handler(
+            PEER_SERVICE,
+            {
+                "ListPeers": grpc.unary_unary_rpc_method_handler(
+                    self._list_peers,
+                    request_deserializer=bypass,
+                    response_serializer=bypass,
+                ),
+            },
+        )
+
+        class Multi(grpc.GenericRpcHandler):
+            def service(self, details):
+                return observer.service(details) or peer.service(details)
+
+        return Multi()
+
+    def start(self) -> None:
+        self._server.start()
+        self._log.info("hubble flow relay on port %d", self.port)
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._stop.set()
+        self._server.stop(grace)
+
+
+class HubbleClient:
+    """Client for the flow relay (the hubble CLI / relay peer side)."""
+
+    def __init__(self, addr: str = "127.0.0.1:4244"):
+        self._chan = grpc.insecure_channel(addr)
+        bypass = lambda x: x
+        self._get_flows = self._chan.unary_stream(
+            f"/{OBSERVER_SERVICE}/GetFlows",
+            request_serializer=bypass, response_deserializer=bypass,
+        )
+        self._status = self._chan.unary_unary(
+            f"/{OBSERVER_SERVICE}/ServerStatus",
+            request_serializer=bypass, response_deserializer=bypass,
+        )
+        self._peers = self._chan.unary_unary(
+            f"/{PEER_SERVICE}/ListPeers",
+            request_serializer=bypass, response_deserializer=bypass,
+        )
+
+    def get_flows(
+        self,
+        filter: Optional[FlowFilter] = None,
+        last: int = 0,
+        follow: bool = False,
+        timeout: Optional[float] = None,
+    ) -> Iterator[dict[str, Any]]:
+        req = {"last": last, "follow": follow}
+        if filter is not None:
+            req["filter"] = filter.to_dict()
+        for raw in self._get_flows(_pack(req), timeout=timeout):
+            yield _unpack(raw)
+
+    def server_status(self) -> dict[str, Any]:
+        return _unpack(self._status(_pack({}), timeout=5))
+
+    def list_peers(self) -> list[dict[str, str]]:
+        return _unpack(self._peers(_pack({}), timeout=5))["peers"]
+
+    def close(self) -> None:
+        self._chan.close()
