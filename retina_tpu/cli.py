@@ -1,0 +1,348 @@
+"""retina-tpu CLI — the kubectl-retina analog.
+
+Reference analog: cli/ (kubectl-retina: capture create/list/download/
+delete, shell, trace, config, version; cli/cmd/capture/create.go:109
+drives the capture translator directly in operator-less mode) plus the
+agent/operator binaries (controller/main.go, operator/main.go). One
+entry point here, subcommand per role:
+
+  agent     run the node agent daemon
+  operator  run the operator over a watch directory of CRD YAMLs
+  capture   create/list/download/delete packet captures (operator-less)
+  observe   stream flows from the Hubble relay (hubble observe analog)
+  top       heavy-hitter tables from a running agent
+  config    print the effective layered configuration
+  trace     trace configuration (stub parity with cli/cmd/trace.go)
+  shell     drop into a network-debug shell (shell/ analog)
+  version   print version
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from typing import Any
+
+from retina_tpu.utils import buildinfo
+
+
+def _parse_overrides(pairs: list[str]) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for p in pairs:
+        if "=" not in p:
+            raise SystemExit(f"--set expects key=value, got {p!r}")
+        k, _, v = p.partition("=")
+        out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------- agent
+def cmd_agent(args: argparse.Namespace) -> int:
+    from retina_tpu.daemon import run_agent
+
+    run_agent(
+        config_path=args.config,
+        overrides=_parse_overrides(args.set or []),
+        apiserver_host=args.apiserver,
+    )
+    return 0
+
+
+# -------------------------------------------------------------- operator
+def cmd_operator(args: argparse.Namespace) -> int:
+    """Watch a directory of CRD YAMLs and reconcile (the operator main).
+
+    File naming: kind is read from each document's ``kind:`` field.
+    """
+    import yaml
+
+    from retina_tpu.crd.types import (
+        Capture,
+        MetricsConfiguration,
+        TracesConfiguration,
+    )
+    from retina_tpu.log import setup_logger
+    from retina_tpu.operator import CRDStore, Operator
+
+    setup_logger()
+    store = CRDStore()
+    op = Operator(store, node_name=args.node_name)
+    op.start()
+    seen: dict[str, float] = {}
+    print(f"operator watching {args.watch_dir} (ctrl-c to stop)")
+    try:
+        while True:
+            for fname in sorted(os.listdir(args.watch_dir)):
+                if not fname.endswith((".yaml", ".yml")):
+                    continue
+                path = os.path.join(args.watch_dir, fname)
+                mtime = os.path.getmtime(path)
+                if seen.get(path) == mtime:
+                    continue
+                seen[path] = mtime
+                with open(path) as fh:
+                    doc = yaml.safe_load(fh) or {}
+                kind = doc.get("kind", "")
+                try:
+                    if kind == "Capture":
+                        store.apply("Capture", Capture.from_yaml(
+                            yaml.safe_dump(doc)))
+                    elif kind == "MetricsConfiguration":
+                        store.apply(
+                            "MetricsConfiguration",
+                            MetricsConfiguration.from_yaml(
+                                yaml.safe_dump(doc)),
+                        )
+                    elif kind == "TracesConfiguration":
+                        store.apply("TracesConfiguration",
+                                    TracesConfiguration(
+                                        name=doc.get("metadata", {}).get(
+                                            "name", "default")))
+                    else:
+                        print(f"skipping {fname}: unknown kind {kind!r}")
+                except Exception as e:
+                    print(f"error applying {fname}: {e}", file=sys.stderr)
+            time.sleep(args.poll_interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+# -------------------------------------------------------------- capture
+def cmd_capture_create(args: argparse.Namespace) -> int:
+    from retina_tpu.capture.manager import CaptureManager
+    from retina_tpu.capture.translator import translate_capture_to_jobs
+    from retina_tpu.common import RetinaNode
+    from retina_tpu.crd.types import (
+        Capture,
+        CaptureOutput,
+        CaptureSpec,
+        CaptureTarget,
+    )
+
+    cap = Capture(
+        name=args.name,
+        namespace=args.namespace,
+        spec=CaptureSpec(
+            target=CaptureTarget(node_names=args.node_names or ["local"]),
+            output=CaptureOutput(host_path=args.host_path),
+            duration_s=args.duration,
+            max_capture_size_mb=args.max_size,
+            tcpdump_filter=args.filter,
+        ),
+    )
+    nodes = [RetinaNode(name=n) for n in (args.node_names or ["local"])]
+    jobs = translate_capture_to_jobs(cap, nodes, [])
+    mgr = CaptureManager()
+    rc = 0
+    for job in jobs:
+        try:
+            artifacts = mgr.run_job(job)
+            for a in artifacts:
+                print(a)
+        except Exception as e:
+            print(f"capture job {job.job_name()} failed: {e}",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_capture_list(args: argparse.Namespace) -> int:
+    if not os.path.isdir(args.host_path):
+        print("no captures found")
+        return 0
+    for f in sorted(os.listdir(args.host_path)):
+        if f.endswith(".tar.gz"):
+            st = os.stat(os.path.join(args.host_path, f))
+            print(f"{f}\t{st.st_size}\t{time.ctime(st.st_mtime)}")
+    return 0
+
+
+def cmd_capture_download(args: argparse.Namespace) -> int:
+    import shutil
+
+    src = os.path.join(args.host_path, args.file)
+    if not os.path.exists(src):
+        print(f"not found: {src}", file=sys.stderr)
+        return 1
+    dst = shutil.copy2(src, args.output)
+    print(dst)
+    return 0
+
+
+def cmd_capture_delete(args: argparse.Namespace) -> int:
+    src = os.path.join(args.host_path, args.file)
+    try:
+        os.unlink(src)
+        print(f"deleted {src}")
+        return 0
+    except OSError as e:
+        print(f"delete failed: {e}", file=sys.stderr)
+        return 1
+
+
+# --------------------------------------------------------------- observe
+def cmd_observe(args: argparse.Namespace) -> int:
+    from retina_tpu.hubble.flow import FlowFilter
+    from retina_tpu.hubble.server import HubbleClient
+
+    client = HubbleClient(args.server)
+    filt = FlowFilter(
+        pod=args.pod, namespace=args.namespace, verdict=args.verdict,
+        protocol=args.protocol, port=args.port,
+    )
+    try:
+        for flow in client.get_flows(
+            filter=filt, last=args.last, follow=args.follow
+        ):
+            if args.json:
+                print(json.dumps(flow))
+            else:
+                src = flow.get("source", {}).get("pod_name") or \
+                    flow["ip"]["source"]
+                dst = flow.get("destination", {}).get("pod_name") or \
+                    flow["ip"]["destination"]
+                l4 = flow["l4"]
+                print(
+                    f"{src}:{l4['source_port']} -> {dst}:"
+                    f"{l4['destination_port']} {l4['protocol']} "
+                    f"{flow['verdict']} {flow['event_type']}"
+                )
+    except KeyboardInterrupt:
+        pass
+    finally:
+        client.close()
+    return 0
+
+
+# ------------------------------------------------------------------ top
+def cmd_top(args: argparse.Namespace) -> int:
+    url = f"http://{args.server}/debug/vars"
+    doc = json.loads(urllib.request.urlopen(url, timeout=5).read())
+    key = f"top_{args.what}"
+    rows = doc.get(key)
+    if rows is None:
+        print(f"agent does not expose {key}", file=sys.stderr)
+        return 1
+    for row in rows:
+        print("\t".join(str(c) for c in row))
+    return 0
+
+
+# --------------------------------------------------------------- config
+def cmd_config(args: argparse.Namespace) -> int:
+    import dataclasses
+
+    import yaml
+
+    from retina_tpu.config import load_config
+
+    cfg = load_config(args.config, overrides=_parse_overrides(args.set or []))
+    print(yaml.safe_dump(dataclasses.asdict(cfg), sort_keys=True))
+    return 0
+
+
+# ---------------------------------------------------------- trace/shell
+def cmd_trace(args: argparse.Namespace) -> int:
+    # Parity with cli/cmd/trace.go:11-17 — a declared-but-stub command.
+    print("trace: not yet implemented (stub parity with the reference)")
+    return 0
+
+
+def cmd_shell(args: argparse.Namespace) -> int:
+    # Reference shell/ launches a debug pod with networking tools; local
+    # analog: an interactive shell with the agent env.
+    shell = os.environ.get("SHELL", "/bin/sh")
+    os.execvp(shell, [shell])
+
+
+def cmd_version(args: argparse.Namespace) -> int:
+    print(f"{buildinfo.APP_NAME} {buildinfo.VERSION}")
+    return 0
+
+
+# ---------------------------------------------------------------- parser
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="retina-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    a = sub.add_parser("agent", help="run the node agent")
+    a.add_argument("--config", default=None, help="YAML config path")
+    a.add_argument("--set", action="append", metavar="KEY=VAL")
+    a.add_argument("--apiserver", default="", help="apiserver host to watch")
+    a.set_defaults(fn=cmd_agent)
+
+    o = sub.add_parser("operator", help="run the operator")
+    o.add_argument("--watch-dir", required=True)
+    o.add_argument("--node-name", default="local")
+    o.add_argument("--poll-interval", type=float, default=2.0)
+    o.set_defaults(fn=cmd_operator)
+
+    cap = sub.add_parser("capture", help="packet captures")
+    csub = cap.add_subparsers(dest="capture_cmd", required=True)
+    cc = csub.add_parser("create")
+    cc.add_argument("--name", required=True)
+    cc.add_argument("--namespace", default="default")
+    cc.add_argument("--node-names", nargs="*", default=None)
+    cc.add_argument("--host-path", required=True)
+    cc.add_argument("--duration", type=int, default=10)
+    cc.add_argument("--max-size", type=int, default=100)
+    cc.add_argument("--filter", default="")
+    cc.set_defaults(fn=cmd_capture_create)
+    cl = csub.add_parser("list")
+    cl.add_argument("--host-path", required=True)
+    cl.set_defaults(fn=cmd_capture_list)
+    cd = csub.add_parser("download")
+    cd.add_argument("--host-path", required=True)
+    cd.add_argument("--file", required=True)
+    cd.add_argument("--output", default=".")
+    cd.set_defaults(fn=cmd_capture_download)
+    cx = csub.add_parser("delete")
+    cx.add_argument("--host-path", required=True)
+    cx.add_argument("--file", required=True)
+    cx.set_defaults(fn=cmd_capture_delete)
+
+    ob = sub.add_parser("observe", help="stream flows from the relay")
+    ob.add_argument("--server", default="127.0.0.1:4244")
+    ob.add_argument("--follow", action="store_true")
+    ob.add_argument("--last", type=int, default=20)
+    ob.add_argument("--pod")
+    ob.add_argument("--namespace")
+    ob.add_argument("--verdict")
+    ob.add_argument("--protocol")
+    ob.add_argument("--port", type=int)
+    ob.add_argument("--json", action="store_true")
+    ob.set_defaults(fn=cmd_observe)
+
+    tp = sub.add_parser("top", help="heavy-hitter tables")
+    tp.add_argument("what", choices=["flows", "services", "dns"])
+    tp.add_argument("--server", default="127.0.0.1:10093")
+    tp.set_defaults(fn=cmd_top)
+
+    cf = sub.add_parser("config", help="print effective config")
+    cf.add_argument("--config", default=None)
+    cf.add_argument("--set", action="append", metavar="KEY=VAL")
+    cf.set_defaults(fn=cmd_config)
+
+    tr = sub.add_parser("trace", help="trace configuration (stub)")
+    tr.set_defaults(fn=cmd_trace)
+
+    sh = sub.add_parser("shell", help="network debug shell")
+    sh.set_defaults(fn=cmd_shell)
+
+    v = sub.add_parser("version")
+    v.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

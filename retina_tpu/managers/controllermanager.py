@@ -97,7 +97,43 @@ class ControllerManager:
         self.server.expose_var(
             "heartbeat", lambda: self.telemetry.last_heartbeat
         )
+        self.server.expose_var("top_flows", self._top_flows)
+        self.server.expose_var("top_services", self._top_services)
+        self.server.expose_var("top_dns", self._top_dns)
         self.engine.compile()
+
+    # -- heavy-hitter views for /debug/vars (CLI `top` command) --------
+    def _top_flows(self) -> list[list]:
+        from retina_tpu.events.schema import u32_to_ip
+
+        keys, counts = self.engine.top_flows(20)
+        return [
+            [u32_to_ip(int(k[0])), u32_to_ip(int(k[1])),
+             int(k[2]) >> 16, int(k[2]) & 0xFFFF, int(k[3]), int(c)]
+            for k, c in zip(keys, counts)
+        ]
+
+    def _top_services(self) -> list[list]:
+        labeler = self.cache.index_label_map()
+        keys, counts = self.engine.top_services(20)
+        out = []
+        for k, c in zip(keys, counts):
+            src = labeler.get(int(k[0]))
+            dst = labeler.get(int(k[1]))
+            out.append([
+                src.key() if src else f"pod:{int(k[0])}",
+                dst.key() if dst else f"pod:{int(k[1])}",
+                int(c),
+            ])
+        return out
+
+    def _top_dns(self) -> list[list]:
+        dns = self.pluginmanager.plugins.get("dns")
+        keys, counts = self.engine.top_dns(20)
+        return [
+            [dns.resolve(int(k[0])) if dns else hex(int(k[0])), int(c)]
+            for k, c in zip(keys, counts)
+        ]
 
     def start(self, stop: threading.Event) -> None:
         """Run everything; returns when ``stop`` fires (errgroup shape)."""

@@ -1,0 +1,86 @@
+"""CLI tests (cli/ analog): capture create/list/download/delete round
+trip with the replay provider, config printing with layering, version,
+trace stub — driven through the argparse entry point like the reference's
+cobra command tests."""
+
+import os
+
+import pytest
+
+import retina_tpu.capture.manager as capture_manager_mod
+from retina_tpu.capture.providers import ReplayProvider
+from retina_tpu.cli import main
+from retina_tpu.utils import buildinfo
+
+
+@pytest.fixture
+def replay_capture(monkeypatch):
+    """Force the capture manager onto the replay provider with a canned
+    source (no tcpdump/root dependency in CI)."""
+    from tests.test_capture_operator import make_source
+
+    orig_init = capture_manager_mod.CaptureManager.__init__
+
+    def patched(self, provider=None):
+        orig_init(self, provider or ReplayProvider(source=make_source()))
+
+    monkeypatch.setattr(
+        capture_manager_mod.CaptureManager, "__init__", patched
+    )
+
+
+def test_version(capsys):
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out
+    assert buildinfo.VERSION in out
+
+
+def test_trace_stub(capsys):
+    assert main(["trace"]) == 0
+    assert "not yet implemented" in capsys.readouterr().out
+
+
+def test_config_print_with_overrides(tmp_path, capsys):
+    cfgfile = tmp_path / "c.yaml"
+    cfgfile.write_text("enabledPlugin: [dns]\n")
+    assert main(["config", "--config", str(cfgfile),
+                 "--set", "batch_capacity=4096"]) == 0
+    out = capsys.readouterr().out
+    assert "- dns" in out
+    assert "batch_capacity: 4096" in out
+
+
+def test_capture_lifecycle(tmp_path, capsys, replay_capture):
+    art = str(tmp_path / "artifacts")
+    rc = main([
+        "capture", "create", "--name", "t1", "--host-path", art,
+        "--duration", "1",
+    ])
+    assert rc == 0
+    created = capsys.readouterr().out.strip().splitlines()
+    assert created and created[0].endswith(".tar.gz")
+    fname = os.path.basename(created[0])
+
+    assert main(["capture", "list", "--host-path", art]) == 0
+    assert fname in capsys.readouterr().out
+
+    dl = str(tmp_path / "dl")
+    os.makedirs(dl)
+    assert main(["capture", "download", "--host-path", art,
+                 "--file", fname, "--output", dl]) == 0
+    capsys.readouterr()
+    assert os.path.exists(os.path.join(dl, fname))
+
+    assert main(["capture", "delete", "--host-path", art,
+                 "--file", fname]) == 0
+    assert main(["capture", "list", "--host-path", art]) == 0
+    assert fname not in capsys.readouterr().out
+
+
+def test_capture_filter_flag(tmp_path, capsys, replay_capture):
+    art = str(tmp_path / "artifacts")
+    rc = main([
+        "capture", "create", "--name", "t2", "--host-path", art,
+        "--duration", "1", "--filter", "host 10.0.0.5",
+    ])
+    assert rc == 0
