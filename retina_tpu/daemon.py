@@ -92,8 +92,16 @@ class Daemon:
                 try:
                     self.cm.engine.load_snapshot_state(path)
                     self.log.info("resumed sketch state from %s", path)
-                except ValueError as e:
-                    self.log.warning("stale checkpoint ignored: %s", e)
+                except Exception as e:
+                    # Any unreadable checkpoint (stale fingerprint, corrupt
+                    # or truncated npz) must not crash-loop the agent: move
+                    # it aside and start fresh.
+                    self.log.warning("checkpoint ignored (%s): %s",
+                                     type(e).__name__, e)
+                    try:
+                        os.replace(path, path + ".bad")
+                    except OSError:
+                        pass
         try:
             self.cm.start(stop)  # blocks until stop fires; runs shutdown
         finally:

@@ -70,6 +70,17 @@ class PacketParserPlugin(Plugin):
                 res.n_decoded, res.n_packets_total, self.cfg.pcap_path,
             )
 
+    def _publish_dns_names(self, names: dict[int, str]) -> None:
+        """Feed the DnsPlugin string table (externalevents does the same
+        for its frames) so hubble l7_dns.query / top_dns labels resolve
+        for pcap and live sources, not just external frames."""
+        if not names:
+            return
+        from retina_tpu.plugins.dns import TOPIC_DNS_NAMES
+        from retina_tpu.pubsub import get_pubsub
+
+        get_pubsub().publish(TOPIC_DNS_NAMES, dict(names))
+
     def init(self) -> None:
         if self.cfg.event_source == "live":
             self._open_socket()
@@ -91,6 +102,10 @@ class PacketParserPlugin(Plugin):
 
     # -- feed loop ---------------------------------------------------
     def start(self, stop: threading.Event) -> None:
+        # Publish any names decoded during compile() only now: Start runs
+        # after every plugin's Init, so the DnsPlugin subscription exists
+        # (publishing from compile() would race plugin reconcile order).
+        self._publish_dns_names(self.dns_names)
         src = self.cfg.event_source
         if src == "synthetic":
             self._run_synthetic(stop)
@@ -168,6 +183,7 @@ class PacketParserPlugin(Plugin):
             res = decode_pcap_bytes(b"".join(parts))
             if res.dns_names:
                 self.dns_names.update(res.dns_names)
+                self._publish_dns_names(res.dns_names)
             self.emit(res.records)
 
     def stop(self) -> None:

@@ -42,9 +42,15 @@ PCAP_MAGIC_US = 0xA1B2C3D4
 PCAP_MAGIC_NS = 0xA1B23C4D
 
 
-def dns_qname_hash(name: str) -> int:
-    """Stable 32-bit hash for DNS query names (crc32 — host-side only)."""
-    return zlib.crc32(name.lower().encode()) & 0xFFFFFFFF
+def dns_qname_hash(name: str | bytes) -> int:
+    """Stable 32-bit hash for DNS query names (crc32 — host-side only).
+
+    Hashes the raw label bytes with ASCII-only lowercasing so the value is
+    bit-identical to the C++ decoder (decoder.cpp parse_dns), which never
+    round-trips through unicode."""
+    raw = name.encode("latin-1", "replace") if isinstance(name, str) else name
+    lowered = bytes(c + 32 if 0x41 <= c <= 0x5A else c for c in raw)
+    return zlib.crc32(lowered) & 0xFFFFFFFF
 
 
 @dataclasses.dataclass
@@ -156,7 +162,9 @@ def _dns_name_pass(data: bytes) -> dict[int, str]:
             continue
         parsed = _parse_dns(data, l4 + 8, off + incl)
         if parsed is not None:
-            names[dns_qname_hash(parsed[0])] = parsed[0]
+            names[dns_qname_hash(parsed[0])] = parsed[0].decode(
+                "ascii", "replace"
+            )
     return names
 
 
@@ -242,10 +250,16 @@ def _decode_pcap_numpy(
             tsval = np.where(is_ts, _gather_u32(buf, ts_at + 2), tsval)
             tsecr = np.where(is_ts, _gather_u32(buf, ts_at + 6), tsecr)
             active &= ~is_ts & (kind != 0)
+            # A non-NOP option kind with no room left for its length byte
+            # ends the walk (decoder.cpp: `if (p + 1 >= opt_len) break`) —
+            # and keeps the length-byte gather below in bounds even when
+            # the options region ends exactly at the capture buffer end.
+            active &= (kind == 1) | (pos + 1 < opt_len)
+            need_len = active & (kind != 1)
             length = np.where(
                 kind == 1, 1, np.where(
-                    active, np.maximum(
-                        _gather_u8(buf, np.where(active, cur + 1, 0)), 2
+                    need_len, np.maximum(
+                        _gather_u8(buf, np.where(need_len, cur + 1, 0)), 2
                     ), 1
                 )
             )
@@ -291,7 +305,7 @@ def _decode_pcap_numpy(
                 continue
             qname, qtype, rcode, is_resp = parsed
             h = dns_qname_hash(qname)
-            dns_names[h] = qname
+            dns_names[h] = qname.decode("ascii", "replace")
             rec[j, F.DNS] = (
                 ((qtype & 0xFFFF) << 16) | ((rcode & 0xFF) << 8)
                 | (2 if is_resp else 1)
@@ -303,8 +317,10 @@ def _decode_pcap_numpy(
 
 
 def _parse_dns(data: bytes, off: int, end: int):
-    """Parse DNS header + first question. Returns (qname, qtype, rcode,
-    is_response) or None."""
+    """Parse DNS header + first question. Returns (qname_raw: bytes, qtype,
+    rcode, is_response) or None. The raw label bytes (not a unicode
+    round-trip) are what gets hashed — decoder.cpp parse_dns parity,
+    including its rejection of truncated labels and names > 255 bytes."""
     if end - off < 12:
         return None
     flags = struct.unpack_from(">H", data, off + 2)[0]
@@ -313,7 +329,8 @@ def _parse_dns(data: bytes, off: int, end: int):
         return None
     is_resp = bool(flags & 0x8000)
     rcode = flags & 0xF
-    labels = []
+    labels: list[bytes] = []
+    nlen = 0
     p = off + 12
     for _ in range(64):
         if p >= end:
@@ -325,12 +342,15 @@ def _parse_dns(data: bytes, off: int, end: int):
         if ln >= 0xC0:  # compression pointer — name done elsewhere
             p += 2
             break
-        labels.append(data[p + 1 : p + 1 + ln].decode("ascii", "replace"))
+        if p + 1 + ln > end or nlen + ln + 1 > 256:
+            return None
+        labels.append(data[p + 1 : p + 1 + ln])
+        nlen += ln + (1 if nlen else 0)  # dot only between labels
         p += 1 + ln
     if p + 4 > end:
         return None
     qtype = struct.unpack_from(">H", data, p)[0]
-    return ".".join(labels), qtype, rcode, is_resp
+    return b".".join(labels), qtype, rcode, is_resp
 
 
 def decode_pcap_file(path: str, **kw) -> PcapDecodeResult:

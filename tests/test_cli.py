@@ -73,6 +73,7 @@ def test_capture_lifecycle(tmp_path, capsys, replay_capture):
 
     assert main(["capture", "delete", "--host-path", art,
                  "--file", fname]) == 0
+    capsys.readouterr()  # drain the delete echo before asserting on list
     assert main(["capture", "list", "--host-path", art]) == 0
     assert fname not in capsys.readouterr().out
 

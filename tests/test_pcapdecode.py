@@ -113,3 +113,50 @@ def test_not_a_pcap():
         decode_pcap_bytes(b"\x00" * 100)
     empty = decode_pcap_bytes(b"")
     assert empty.n_decoded == 0
+
+
+def _pcap_of_raw_frames(frames: list[bytes]) -> bytes:
+    import struct
+
+    out = [struct.pack("<IHHiIII", 0xA1B23C4D, 2, 4, 0, 0, 65535, 1)]
+    for fr in frames:
+        out.append(struct.pack("<IIII", 0, 0, len(fr), len(fr)))
+        out.append(fr)
+    return b"".join(out)
+
+
+def test_truncated_trailing_option_at_buffer_end():
+    """A trailing non-NOP option kind whose length byte would sit one past
+    the end of the capture buffer must not crash the numpy decoder
+    (regression: IndexError in the option-walk gather)."""
+    import struct
+
+    # eth + IPv4 + TCP with doff=24: 4 option bytes = NOP NOP NOP 0x02 —
+    # kind 2 (MSS) at the last byte, no room for its length byte.
+    eth = b"\x02\x00\x00\x00\x00\x01\x02\x00\x00\x00\x00\x02\x08\x00"
+    opts = b"\x01\x01\x01\x02"
+    total = 20 + 20 + len(opts)
+    ip = struct.pack(
+        ">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, PROTO_TCP, 0, 1, 2
+    )
+    tcp = struct.pack(
+        ">HHIIBBHHH", 1234, 80, 0, 0, (24 // 4) << 4, 0x10, 8192, 0, 0
+    ) + opts
+    frame = eth + ip + tcp  # packet ends exactly at buffer end
+    res = decode_pcap_bytes(
+        _pcap_of_raw_frames([frame]), prefer_native=False
+    )
+    assert res.n_decoded == 1
+    assert res.records[0][F.TSVAL] == 0
+
+
+def test_qname_hash_raw_bytes_parity():
+    """dns_qname_hash must hash raw label bytes (ASCII-lowercased), never a
+    unicode round-trip — decoder.cpp parity for non-ASCII labels."""
+    import zlib
+
+    raw = b"a\xffB"
+    assert dns_qname_hash(raw) == zlib.crc32(b"a\xffb") & 0xFFFFFFFF
+    assert dns_qname_hash("API.Example.COM") == dns_qname_hash(
+        b"api.example.com"
+    )
