@@ -1,0 +1,35 @@
+import time, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+def log(m): print(m, file=sys.stderr, flush=True)
+from retina_tpu.events.synthetic import TrafficGen
+from retina_tpu.models.identity import IdentityMap
+from retina_tpu.models.pipeline import PipelineConfig, TelemetryPipeline
+
+cfg = PipelineConfig()
+gen = TrafficGen(n_flows=1_000_000, n_pods=2048, seed=42)
+ident = IdentityMap.build_host({0x0A000000+i: i for i in range(1,2048)}, n_slots=1<<16)
+p = TelemetryPipeline(cfg)
+
+for logB in (17, 18, 19, 20):
+    B = 1 << logB
+    N = max(2, (1 << 21) >> logB)
+    batches = jax.device_put(np.concatenate([gen.batch(1<<17) for _ in range(B >> 17)] , axis=0)[None].repeat(N, axis=0)) if False else jax.device_put(np.stack([np.concatenate([gen.batch(1<<17) for _ in range(B >> 17)], axis=0) for _ in range(N)]))
+    state = p.init_state()
+    def body(s, rec):
+        s, _ = p.step(s, rec, jnp.uint32(B), jnp.uint32(1), ident, jnp.uint32(0))
+        return s, 0
+    @jax.jit
+    def run(s, bs):
+        s, _ = jax.lax.scan(body, s, bs)
+        return s
+    t0 = time.perf_counter()
+    state = run(state, batches)
+    _ = np.asarray(state.totals)[:1]
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    state = run(state, batches)
+    _ = np.asarray(state.totals)[:1]
+    dt = (time.perf_counter()-t0)/N
+    log(f"B=2^{logB}: {dt*1e3:8.2f} ms/step -> {B/dt/1e6:6.2f} M ev/s (compile {compile_s:.0f}s)")

@@ -1,0 +1,85 @@
+import time, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+def log(m): print(m, file=sys.stderr, flush=True)
+B = 1 << 17
+N = 16
+rng = np.random.default_rng(0)
+batches = jax.device_put(jnp.asarray(rng.integers(0, 1<<31, (N, B, 4), dtype=np.int64), jnp.uint32))
+
+def scan_time(name, body, carry):
+    @jax.jit
+    def run(c, bs):
+        c, _ = jax.lax.scan(body, c, bs)
+        return c
+    c = run(carry, batches)
+    _ = np.asarray(jax.tree_util.tree_leaves(c)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    c = run(c, batches)
+    _ = np.asarray(jax.tree_util.tree_leaves(c)[0]).ravel()[:1]
+    dt = (time.perf_counter()-t0)/N
+    log(f"{name:44s} {dt*1e3:8.2f} ms")
+
+for logsz in (12, 15, 18, 20):
+    sz = 1 << logsz
+    def b_sc(s, rec, sz=sz):
+        i = (rec[:,0] & jnp.uint32(sz-1)).astype(jnp.int32)
+        return s.at[i].add(rec[:,1]), 0
+    scan_time(f"scatter-add B into 2^{logsz} table", b_sc, jnp.zeros(sz, jnp.uint32))
+
+def b_sc_u(s, rec):
+    i = (rec[:,0] & jnp.uint32((1<<18)-1)).astype(jnp.int32)
+    return s.at[i].add(rec[:,1], unique_indices=True), 0
+scan_time("scatter-add 2^18 unique_indices=True", b_sc_u, jnp.zeros(1<<18, jnp.uint32))
+
+for logsz in (15, 18):
+    sz = 1 << logsz
+    def b_g(s, rec, sz=sz):
+        i = (rec[:,0] & jnp.uint32(sz-1)).astype(jnp.int32)
+        return s + jnp.sum(jnp.zeros(sz, jnp.uint32).at[0].set(s)[i] + i.astype(jnp.uint32)), 0
+    # simpler: gather from a carried table
+    def b_g2(carry, rec, sz=sz):
+        tbl, acc = carry
+        i = (rec[:,0] & jnp.uint32(sz-1)).astype(jnp.int32)
+        return (tbl, acc + jnp.sum(tbl[i])), 0
+    scan_time(f"gather B from 2^{logsz} table", b_g2, (jnp.ones(sz, jnp.uint32), jnp.uint32(0)))
+
+def b_rowg(carry, rec):
+    tbl, acc = carry
+    i = (rec[:,0] & jnp.uint32((1<<16)-1)).astype(jnp.int32)
+    rows = tbl[i]  # (B, 2)
+    return (tbl, acc + jnp.sum(rows)), 0
+scan_time("row-gather (B,2) from (2^16,2)", b_rowg, (jnp.ones((1<<16,2), jnp.uint32), jnp.uint32(0)))
+
+def b_rowg4(carry, rec):
+    tbl, acc = carry
+    i = (rec[:,0] & jnp.uint32((1<<18)-1)).astype(jnp.int32)
+    rows = tbl[i]  # (B, 4)
+    return (tbl, acc + jnp.sum(rows)), 0
+scan_time("row-gather (B,4) from (2^18,4)", b_rowg4, (jnp.ones((1<<18,4), jnp.uint32), jnp.uint32(0)))
+
+def b_rowsc(s, rec):
+    i = (rec[:,0] & jnp.uint32((1<<12)-1)).astype(jnp.int32)
+    vals = jnp.stack([rec[:,1], rec[:,2], rec[:,3], rec[:,1]], axis=1)
+    return s.at[i].add(vals), 0
+scan_time("row-scatter (B,4) into (2^12,4)", b_rowsc, jnp.zeros((1<<12,4), jnp.uint32))
+
+def b_sortseg(s, rec):
+    k = rec[:,0] & jnp.uint32(0xFFF)
+    v = rec[:,1]
+    ks, vs = jax.lax.sort((k, v), num_keys=1)
+    csum = jnp.cumsum(vs.astype(jnp.uint32))
+    last = jnp.concatenate([ks[1:] != ks[:-1], jnp.array([True])])
+    seg = jnp.where(last, csum, 0)
+    prev = jnp.where(last, jnp.concatenate([jnp.zeros(1, jnp.uint32), jnp.where(last, csum, 0)[:-1]]), 0)
+    # proper segment totals: csum at last minus csum at previous segment's last
+    idx = jnp.where(last, ks, jnp.uint32(1<<12)).astype(jnp.int32)
+    return s.at[idx].add(seg, mode="drop"), 0
+scan_time("sort+cumsum+unique scatter (approx)", b_sortseg, jnp.zeros(1<<12, jnp.uint32))
+
+def b_sort3(s, rec):
+    a, b_, c, d = rec[:,0], rec[:,1], rec[:,2], rec[:,3]
+    ks, v1, v2, v3 = jax.lax.sort((a, b_, c, d), num_keys=1)
+    return s + ks[0] + v1[-1] + v2[0] + v3[-1], 0
+scan_time("sort 1 key + 3 payloads", b_sort3, jnp.uint32(0))

@@ -1,0 +1,38 @@
+import time, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+def log(m): print(m, file=sys.stderr, flush=True)
+B = 1 << 17
+rng = np.random.default_rng(0)
+idx32k = jax.device_put(jnp.asarray(rng.integers(0, 1<<15, B), jnp.int32))
+idx4k = jax.device_put(jnp.asarray(rng.integers(0, 1<<12, B), jnp.int32))
+vals = jax.device_put(jnp.asarray(rng.integers(1, 100, B), jnp.uint32))
+keys = jax.device_put(jnp.asarray(rng.integers(0, 1<<32, B, dtype=np.uint64), jnp.uint32))
+table64k = jax.device_put(jnp.asarray(rng.integers(0, 1<<20, 1<<16), jnp.uint32))
+
+def timeit_chain(name, fn, init, *args, n=20):
+    f = jax.jit(fn)
+    c = f(init, *args); _ = np.asarray(jax.tree_util.tree_leaves(c)[0])[:1]
+    t0 = time.perf_counter()
+    for _i in range(n): c = f(c, *args)
+    _ = np.asarray(jax.tree_util.tree_leaves(c)[0])[:1]  # real host fetch
+    dt = (time.perf_counter()-t0)/n
+    log(f"{name:40s} {dt*1e3:8.3f} ms ({B/dt/1e6:9.1f} M/s)")
+
+timeit_chain("noop carry+1", lambda c: c+1, vals)
+timeit_chain("matmul 4096 bf16 chained",
+    lambda c: (c @ c) * jnp.bfloat16(1e-4), jnp.ones((4096,4096), jnp.bfloat16) * jnp.bfloat16(0.01), n=30)
+timeit_chain("gather: c += t[(idx^c[0])&0xFFFF]",
+    lambda c, t, i: c + t[(i ^ (c[:1].astype(jnp.int32))) & 0xFFFF],
+    vals, table64k, idx32k)
+timeit_chain("scatter-add into carry 32k",
+    lambda c, i, v: c.at[i].add(v), jnp.zeros(1<<15, jnp.uint32), idx32k, vals)
+timeit_chain("scatter-add carry 256k",
+    lambda c, i, v: c.at[(i*7)&0x3FFFF].add(v), jnp.zeros(1<<18, jnp.uint32), idx32k, vals)
+timeit_chain("sort pair (k^c, v)",
+    lambda c, k, v: jax.lax.sort((k ^ c[:1], v), num_keys=1)[0], keys, keys, vals)
+def oh32_chain(c, i, v):
+    oh = jax.nn.one_hot((i + c[0].astype(jnp.int32)) & 0x7FFF, 1<<15, dtype=jnp.bfloat16)
+    return c + (v.astype(jnp.bfloat16) @ oh).astype(jnp.uint32)
+timeit_chain("one-hot matmul 131k->32k chained", oh32_chain, jnp.zeros(1<<15, jnp.uint32), idx32k, vals)

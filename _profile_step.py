@@ -1,0 +1,88 @@
+"""Per-component timing of the pipeline step on TPU (ablation profile)."""
+import sys, time
+import numpy as np
+
+def log(m):
+    print(f"[{time.strftime('%H:%M:%S')}] {m}", file=sys.stderr, flush=True)
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+log(f"devices: {jax.devices()}")
+
+from retina_tpu.events.synthetic import TrafficGen
+from retina_tpu.models.identity import IdentityMap
+from retina_tpu.models.pipeline import PipelineConfig, TelemetryPipeline
+from retina_tpu.events.schema import F
+
+B = 1 << 17
+cfg = PipelineConfig()
+gen = TrafficGen(n_flows=1_000_000, n_pods=2048, seed=42)
+rec = jax.device_put(gen.batch(B))
+ident = IdentityMap.build_host({0x0A000000 + i: i for i in range(1, 2048)}, n_slots=1 << 16)
+p = TelemetryPipeline(cfg)
+state = p.init_state()
+
+col = lambda i: rec[:, i]
+src_ip = col(F.SRC_IP); dst_ip = col(F.DST_IP)
+ports = col(F.PORTS); meta = col(F.META)
+proto = meta >> 24
+bytes_, packets = col(F.BYTES), col(F.PACKETS)
+mask = jnp.ones((B,), bool)
+w = packets
+
+
+def timeit(name, fn, *args, n=10):
+    try:
+        f = jax.jit(fn)
+        out = f(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / n
+        log(f"{name:32s} {dt*1e3:8.2f} ms  ({B/dt/1e6:8.1f} M ev/s)")
+    except Exception as e:
+        log(f"{name:32s} FAILED {type(e).__name__}: {e}")
+
+
+five = [src_ip, dst_ip, ports, proto]
+
+timeit("identity.lookup x2", lambda s, d: (ident.lookup(s), ident.lookup(d)), src_ip, dst_ip)
+timeit("cms only (flow_hh.cms.update)", lambda c: c.update(five, w), state.flow_hh.cms)
+timeit("flow_hh full (cms+slots)", lambda h: h.update(five, w), state.flow_hh)
+timeit("svc_hh full", lambda h: h.update([src_ip, dst_ip], w), state.svc_hh)
+timeit("hll_flows (G=1)", lambda h: h.update(five, jnp.zeros_like(src_ip), mask), state.hll_flows)
+timeit("hll_src_per_pod (G=4096)", lambda h: h.update([src_ip], jnp.zeros_like(src_ip), mask), state.hll_src_per_pod)
+timeit("entropy x1", lambda e: e.update([src_ip], jnp.zeros_like(src_ip), jnp.ones((B,), jnp.float32)), state.entropy)
+timeit("conntrack.process", lambda c: c.process(src_ip, dst_ip, ports, proto, (meta >> 16) & jnp.uint32(0xFF), jnp.uint32(1), bytes_, mask)[0], state.conntrack)
+
+def dense(pf):
+    lp = jnp.minimum(ident.lookup(dst_ip), jnp.uint32(cfg.n_pods - 1))
+    d = jnp.zeros((B,), jnp.uint32)
+    pf = pf.at[lp, d, 0].add(packets, mode="drop")
+    pf = pf.at[lp, d, 1].add(bytes_, mode="drop")
+    return pf
+timeit("dense pod_forward scatter x2", dense, state.pod_forward)
+
+def tcpflags(ptf):
+    lp = jnp.minimum(ident.lookup(dst_ip), jnp.uint32(cfg.n_pods - 1))
+    tf = (meta >> 16) & jnp.uint32(0xFF)
+    for bit in range(8):
+        has = ((tf >> bit) & 1).astype(bool)
+        ptf = ptf.at[lp, bit].add(jnp.where(has, packets, 0), mode="drop")
+    return ptf
+timeit("tcpflags 8 scatters", tcpflags, state.pod_tcpflags)
+
+step = p.jitted_step()
+s2, _ = step(state, rec, jnp.uint32(B), jnp.uint32(1), ident, jnp.uint32(0))
+jax.block_until_ready(s2.totals)
+t0 = time.perf_counter()
+n = 10
+for i in range(n):
+    s2, _ = step(s2, rec, jnp.uint32(B), jnp.uint32(2), ident, jnp.uint32(0))
+jax.block_until_ready(s2.totals)
+dt = (time.perf_counter() - t0) / n
+log(f"{'FULL STEP':32s} {dt*1e3:8.2f} ms  ({B/dt/1e6:8.1f} M ev/s)")

@@ -1,0 +1,50 @@
+import time, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+def log(m): print(m, file=sys.stderr, flush=True)
+
+# Calibrate: 50 chained 4096^3 matmuls inside one scan dispatch
+def mm_body(c, _):
+    return (c @ c) * jnp.bfloat16(1e-4), 0
+@jax.jit
+def mm50(c):
+    c, _ = jax.lax.scan(mm_body, c, None, length=50)
+    return c
+a = jnp.ones((4096, 4096), jnp.bfloat16) * jnp.bfloat16(0.01)
+r = mm50(a); _ = np.asarray(r)[:1]
+t0 = time.perf_counter(); r = mm50(a); _ = np.asarray(r)[:1]
+dt = (time.perf_counter() - t0) / 50
+log(f"matmul 4096 in-scan: {dt*1e3:.3f} ms -> {2*4096**3/dt/1e12:.1f} TFLOPs")
+
+from retina_tpu.events.synthetic import TrafficGen
+from retina_tpu.models.identity import IdentityMap
+from retina_tpu.models.pipeline import PipelineConfig, TelemetryPipeline
+
+B = 1 << 17
+N = 16
+cfg = PipelineConfig()
+gen = TrafficGen(n_flows=1_000_000, n_pods=2048, seed=42)
+batches = np.stack([gen.batch(B) for _ in range(N)])
+dev_batches = jax.device_put(batches)
+ident = IdentityMap.build_host({0x0A000000+i: i for i in range(1,2048)}, n_slots=1<<16)
+p = TelemetryPipeline(cfg)
+state = p.init_state()
+
+def body(s, rec):
+    s, _ = p.step(s, rec, jnp.uint32(B), jnp.uint32(1), ident, jnp.uint32(0))
+    return s, 0
+@jax.jit
+def run_scan(s, bs):
+    s, _ = jax.lax.scan(body, s, bs)
+    return s
+log("compiling scan step...")
+t0 = time.perf_counter()
+state = run_scan(state, dev_batches)
+_ = np.asarray(state.totals)[:1]
+log(f"compile+first: {time.perf_counter()-t0:.1f}s")
+t0 = time.perf_counter()
+state = run_scan(state, dev_batches)
+_ = np.asarray(state.totals)[:1]
+dt = (time.perf_counter() - t0) / N
+log(f"full step in-scan: {dt*1e3:.2f} ms/step -> {B/dt/1e6:.2f} M ev/s")

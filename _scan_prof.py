@@ -1,0 +1,115 @@
+import time, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+def log(m): print(m, file=sys.stderr, flush=True)
+B = 1 << 17
+N = 16
+rng = np.random.default_rng(0)
+
+from retina_tpu.events.synthetic import TrafficGen
+from retina_tpu.models.identity import IdentityMap
+from retina_tpu.models.pipeline import PipelineConfig, TelemetryPipeline
+from retina_tpu.events.schema import F
+
+cfg = PipelineConfig()
+gen = TrafficGen(n_flows=1_000_000, n_pods=2048, seed=42)
+batches = jax.device_put(np.stack([gen.batch(B) for _ in range(N)]))
+ident = IdentityMap.build_host({0x0A000000+i: i for i in range(1,2048)}, n_slots=1<<16)
+p = TelemetryPipeline(cfg)
+state = p.init_state()
+
+def scan_time(name, body, carry):
+    @jax.jit
+    def run(c, bs):
+        c, _ = jax.lax.scan(body, c, bs)
+        return c
+    c = run(carry, batches)
+    _ = np.asarray(jax.tree_util.tree_leaves(c)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    c = run(c, batches)
+    _ = np.asarray(jax.tree_util.tree_leaves(c)[0]).ravel()[:1]
+    dt = (time.perf_counter()-t0)/N
+    log(f"{name:38s} {dt*1e3:8.2f} ms ({B/dt/1e6:7.1f} M ev/s)")
+
+def cols(rec):
+    c = lambda i: rec[:, i]
+    return c(F.SRC_IP), c(F.DST_IP), c(F.PORTS), c(F.META), c(F.BYTES), c(F.PACKETS)
+
+def b_noop(s, rec):
+    return s + rec[0,0], 0
+scan_time("noop (read 1 elem)", b_noop, jnp.uint32(0))
+
+def b_reduce(s, rec):
+    return s + jnp.sum(rec), 0
+scan_time("sum whole batch (HBM read 8MB)", b_reduce, jnp.uint32(0))
+
+def b_ident(s, rec):
+    si, di, po, me, by, pk = cols(rec)
+    return s + jnp.sum(ident.lookup(si)) + jnp.sum(ident.lookup(di)), 0
+scan_time("identity lookup x2", b_ident, jnp.uint32(0))
+
+def b_cms(s, rec):
+    si, di, po, me, by, pk = cols(rec)
+    return s.update([si, di, po, me >> 24], pk), 0
+scan_time("cms.update (d=4)", b_cms, state.flow_hh.cms)
+
+def b_hh(s, rec):
+    si, di, po, me, by, pk = cols(rec)
+    return s.update([si, di, po, me >> 24], pk), 0
+scan_time("flow_hh.update (cms+slots)", b_hh, state.flow_hh)
+
+def b_hll(s, rec):
+    si, di, po, me, by, pk = cols(rec)
+    return s.update([si, di, po, me >> 24], jnp.zeros_like(si), jnp.ones((B,), bool)), 0
+scan_time("hll_flows", b_hll, state.hll_flows)
+
+def b_hllpod(s, rec):
+    si, di, po, me, by, pk = cols(rec)
+    return s.update([si], jnp.zeros_like(si), jnp.ones((B,), bool)), 0
+scan_time("hll_src_per_pod (G=4096,p=8)", b_hllpod, state.hll_src_per_pod)
+
+def b_ent(s, rec):
+    si, di, po, me, by, pk = cols(rec)
+    one = jnp.ones((B,), jnp.float32)
+    s = s.update([si], jnp.zeros_like(si), one)
+    s = s.update([di], jnp.ones_like(si), one)
+    s = s.update([po & jnp.uint32(0xFFFF)], jnp.full_like(si, 2), one)
+    return s, 0
+scan_time("entropy x3", b_ent, state.entropy)
+
+def b_ct(s, rec):
+    si, di, po, me, by, pk = cols(rec)
+    ct, *_ = s.process(si, di, po, me >> 24, (me >> 16) & jnp.uint32(0xFF), jnp.uint32(1), by, jnp.ones((B,), bool))
+    return ct, 0
+scan_time("conntrack.process", b_ct, state.conntrack)
+
+def b_dense(s, rec):
+    si, di, po, me, by, pk = cols(rec)
+    lp = jnp.minimum(ident.lookup(di), jnp.uint32(cfg.n_pods-1))
+    d = (me >> 4) & jnp.uint32(1)
+    s = s.at[lp, d, 0].add(pk, mode="drop")
+    s = s.at[lp, d, 1].add(by, mode="drop")
+    return s, 0
+scan_time("dense forward (lookup+2 scatters)", b_dense, state.pod_forward)
+
+def b_flags(s, rec):
+    si, di, po, me, by, pk = cols(rec)
+    lp = jnp.minimum(ident.lookup(di), jnp.uint32(cfg.n_pods-1))
+    tf = (me >> 16) & jnp.uint32(0xFF)
+    for bit in range(8):
+        has = ((tf >> bit) & 1).astype(bool)
+        s = s.at[lp, bit].add(jnp.where(has, pk, 0), mode="drop")
+    return s, 0
+scan_time("tcpflags 8 scatters", b_flags, state.pod_tcpflags)
+
+def b_scatter_raw(s, rec):
+    si, di, po, me, by, pk = cols(rec)
+    return s.at[si & jnp.uint32(0x7FFF)].add(pk), 0
+scan_time("raw scatter-add 131k->32k", b_scatter_raw, jnp.zeros(1<<15, jnp.uint32))
+
+def b_sort(s, rec):
+    si, di, po, me, by, pk = cols(rec)
+    k, v = jax.lax.sort((si, pk), num_keys=1)
+    return s + k[0] + v[-1], 0
+scan_time("sort pair 131k", b_sort, jnp.uint32(0))

@@ -55,11 +55,17 @@ class PipelineConfig:
     n_pods: int = 1 << 12  # dense pod-index space (0 = unknown/world)
     n_drop_reasons: int = 16
     n_dns_qtypes: int = 16
-    cms_depth: int = 4
-    cms_width: int = 1 << 15
+    # depth 2 x width 2^16 over the previous 4 x 2^15: same memory, half
+    # the scatter/gather passes (the measured TPU cost driver), and a
+    # tighter per-row error bound e/w*N; failure prob per point query rises
+    # e^-4 -> e^-2, which the candidate slot table's ranking absorbs for
+    # top-k purposes (only relative order of true heavies matters there).
+    cms_depth: int = 2
+    cms_width: int = 1 << 16
     topk_slots: int = 1 << 11
     hll_precision: int = 12
-    hll_pod_precision: int = 8
+    hll_pod_precision: int = 6  # 64 regs: ~13% rel err per-pod, 4x fewer
+    # register lines touched by the scatter-max than p=8
     entropy_buckets: int = 1 << 12
     conntrack_slots: int = 1 << 18
     latency_slots: int = 1 << 12
@@ -212,42 +218,88 @@ class TelemetryPipeline:
         w_bytes = jnp.where(is_fwd, bytes_, 0)
 
         # ---- dense rectangles ----
+        # Every rectangle updates through ONE row-scatter with the counter
+        # pair/bank as the contiguous minor dimension: a (B, C) row update
+        # touches one cache line per event instead of C scattered lines,
+        # and the pass count (the measured TPU cost driver) drops from 17
+        # scatters to 4.
         P = c.n_pods
         local_pod_c = jnp.minimum(local_pod, jnp.uint32(P - 1))
-        pf = state.pod_forward
-        pf = pf.at[local_pod_c, dir_idx, 0].add(w_pkts, mode="drop")
-        pf = pf.at[local_pod_c, dir_idx, 1].add(w_bytes, mode="drop")
+        pf = (
+            state.pod_forward.reshape(P * 2, 2)
+            .at[local_pod_c * 2 + dir_idx]
+            .add(jnp.stack([w_pkts, w_bytes], axis=1), mode="drop")
+            .reshape(P, 2, 2)
+        )
 
-        pd = state.pod_drop
-        pd = pd.at[local_pod_c, reason, 0].add(jnp.where(is_drop, packets, 0), mode="drop")
-        pd = pd.at[local_pod_c, reason, 1].add(jnp.where(is_drop, bytes_, 0), mode="drop")
-
-        # tcp flags: one scatter per flag bit over the batch (8 scatters on
-        # a (P,8) table — XLA folds them into one fused loop).
-        ptf = state.pod_tcpflags
-        is_tcp = mask & (proto == PROTO_TCP)
-        for bit in range(8):
-            has = is_tcp & ((tcp_flags >> bit) & 1).astype(bool)
-            ptf = ptf.at[local_pod_c, bit].add(
-                jnp.where(has, packets, 0), mode="drop"
+        R = c.n_drop_reasons
+        drop_idx = jnp.where(is_drop, local_pod_c * R + reason, jnp.uint32(P * R))
+        pd = (
+            state.pod_drop.reshape(P * R, 2)
+            .at[drop_idx]
+            .add(
+                jnp.stack(
+                    [
+                        jnp.where(is_drop, packets, 0),
+                        jnp.where(is_drop, bytes_, 0),
+                    ],
+                    axis=1,
+                ),
+                mode="drop",
             )
-
-        qtype = jnp.minimum(col(F.DNS) >> 16, jnp.uint32(c.n_dns_qtypes - 1))
-        pdns = state.pod_dns
-        pdns = pdns.at[local_pod_c, qtype, 0].add(
-            jnp.where(is_dns_req, 1, 0).astype(jnp.uint32), mode="drop"
-        )
-        pdns = pdns.at[local_pod_c, qtype, 1].add(
-            jnp.where(is_dns_resp, 1, 0).astype(jnp.uint32), mode="drop"
+            .reshape(P, R, 2)
         )
 
-        pret = state.pod_retrans.at[local_pod_c].add(
-            jnp.where(is_retrans, 1, 0).astype(jnp.uint32), mode="drop"
+        # tcp flags: one (B, 8) row-scatter; non-TCP rows route OOB.
+        is_tcp = mask & (proto == PROTO_TCP)
+        flag_rows = jnp.stack(
+            [
+                jnp.where(((tcp_flags >> bit) & 1).astype(bool), packets, 0)
+                for bit in range(8)
+            ],
+            axis=1,
+        )
+        ptf = state.pod_tcpflags.at[
+            jnp.where(is_tcp, local_pod_c, jnp.uint32(P))
+        ].add(flag_rows, mode="drop")
+
+        Q = c.n_dns_qtypes
+        qtype = jnp.minimum(col(F.DNS) >> 16, jnp.uint32(Q - 1))
+        is_dns = is_dns_req | is_dns_resp
+        dns_idx = jnp.where(is_dns, local_pod_c * Q + qtype, jnp.uint32(P * Q))
+        pdns = (
+            state.pod_dns.reshape(P * Q, 2)
+            .at[dns_idx]
+            .add(
+                jnp.stack(
+                    [
+                        is_dns_req.astype(jnp.uint32),
+                        is_dns_resp.astype(jnp.uint32),
+                    ],
+                    axis=1,
+                ),
+                mode="drop",
+            )
+            .reshape(P, Q, 2)
         )
 
-        nc = state.node_counters
-        nc = nc.at[dir_idx, 0].add(w_pkts, mode="drop")
-        nc = nc.at[dir_idx, 1].add(w_bytes, mode="drop")
+        pret = state.pod_retrans.at[
+            jnp.where(is_retrans, local_pod_c, jnp.uint32(P))
+        ].add(jnp.uint32(1), mode="drop")
+
+        # Node counters are plain masked reductions (no scatter needed):
+        # each masked forward event contributes to exactly one (dir) cell.
+        ing = is_ingress.astype(jnp.uint32)
+        nc = state.node_counters + jnp.stack(
+            [
+                jnp.stack(
+                    [jnp.sum(w_pkts * ing), jnp.sum(w_bytes * ing)]
+                ),
+                jnp.stack(
+                    [jnp.sum(w_pkts * (1 - ing)), jnp.sum(w_bytes * (1 - ing))]
+                ),
+            ]
+        ).astype(jnp.uint32)
 
         # ---- sketches ----
         five = [src_ip, dst_ip, ports, proto]

@@ -6,20 +6,32 @@ Reference behavior (pkg/plugin/conntrack/_cprog/conntrack.c `ct_process_packet`
 SYN/FIN/RST, otherwise at most once per CT_REPORT_INTERVAL (30s) per
 connection — collapsing the per-packet firehose into per-connection reports.
 
-TPU re-design: an LRU hash with per-packet pointer chasing is the opposite
-of what a vector unit wants. Instead:
+TPU re-design (v2 — sort-centric, pass-minimal): an LRU hash with per-packet
+pointer chasing is the opposite of what a vector unit wants, and so is a
+long chain of B-sized gathers/scatters (the measured cost on TPU is the
+*number of random-access passes*, not the compare math). So:
 
-- **direct-mapped slot table** (1-way associative, power-of-two slots):
-  collision = silent eviction, the same degradation mode an LRU shows under
-  pressure, but with O(1) vectorized gather/scatter and zero control flow;
-- **within-batch dedup by sort**: one `argsort` over the batch's key
-  fingerprints marks first occurrences, so a 100k-packet batch of one hot
-  connection reports once, not 100k times;
-- 64-bit key fingerprints (2 x u32) instead of exact 5-tuples (TPUs have no
-  u64; collision odds at 2^64 are ignorable, see ops/hashing.py).
+- **one multi-operand bitonic sort** (`lax.sort`, num_keys=2) groups the
+  batch by connection fingerprint, carrying slot/attr/bytes payloads along
+  (bitonic networks vectorize on the VPU; a sort costs ~2 scatter passes);
+- **segmented associative scan** turns per-connection packet/byte totals
+  and the SYN/FIN/RST "interesting" flag into fused elementwise work;
+- the hash table is **two packed row-tables** — keys (S, 2) [fp_lo, fp_hi]
+  and values (S, 4) [meta, pkts, bytes, spare] — so resident state is TWO
+  row-gathers and the update is TWO row-scatters (vs 7 gathers + 9
+  scatters over scalar columns in v1);
+- `meta` packs last_seen (16-bit wrapping seconds), last_report (14-bit
+  wrapping seconds), an initiator-side bit and a TCP bit into one u32.
+  Wrap-aware deltas cover the reference lifetimes (<= 360 s) with margin;
+  a connection idle > 18 h can misread as fresh once — the same class of
+  degradation an LRU shows under pressure;
+- direct-mapped slots: collision = silent eviction (the LRU's pressure
+  behavior), zero control flow.
 
-State update and report decision are one fused jitted pass; "LRU" recency
-is approximated by last-seen timestamps that new connections overwrite.
+Report decisions and update scatters happen on each connection's LAST row
+in sorted order; returned report masks/payloads are therefore in sorted
+order, which downstream consumers treat as a set (engine.py ignores row
+order; flow export reads only reporting rows).
 """
 
 from __future__ import annotations
@@ -40,41 +52,40 @@ CT_NON_TCP_LIFETIME = 60
 DEFAULT_SLOTS = 1 << 18  # 262,144, matching the reference map size
 
 
+def _seg_scan(first: jnp.ndarray, *values: jnp.ndarray):
+    """Segmented inclusive scans: within each run started by ``first``,
+    uint32 operands accumulate (sum) and bool operands OR. One fused
+    log-depth pass for all operands."""
+
+    def op(a, b):
+        af, avs = a[0], a[1:]
+        bf, bvs = b[0], b[1:]
+        outs = tuple(
+            jnp.where(bf, bv, (av | bv) if av.dtype == jnp.bool_ else av + bv)
+            for av, bv in zip(avs, bvs)
+        )
+        return (af | bf,) + outs
+
+    res = jax.lax.associative_scan(op, (first,) + values)
+    return res[1:]
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class ConntrackTable:
-    """Direct-mapped connection table.
+    """Direct-mapped connection table, packed for row access.
 
-    All arrays are (S,):
-      fp_lo/fp_hi      key fingerprint of the resident connection
-      last_report_s    wall-clock seconds of last emitted report
-      last_seen_s      wall-clock seconds of last packet
-      initiator_ip     src ip of the first packet seen (reply detection)
-      packets/bytes    accumulated since last report (report payload)
-      is_tcp           1 if resident connection is TCP (lifetime selection)
+    keys: (S, 2) uint32 [fp_lo, fp_hi]; (0, 0) marks an empty slot.
+    vals: (S, 4) uint32 [meta, packets, bytes, spare] where meta =
+          seen16 | report14 << 16 | init_is_a << 30 | is_tcp << 31.
     """
 
-    fp_lo: jnp.ndarray
-    fp_hi: jnp.ndarray
-    last_report_s: jnp.ndarray
-    last_seen_s: jnp.ndarray
-    initiator_ip: jnp.ndarray
-    packets: jnp.ndarray
-    bytes: jnp.ndarray
-    is_tcp: jnp.ndarray
+    keys: jnp.ndarray
+    vals: jnp.ndarray
     seed: int = 0
 
     def tree_flatten(self):
-        return (
-            self.fp_lo,
-            self.fp_hi,
-            self.last_report_s,
-            self.last_seen_s,
-            self.initiator_ip,
-            self.packets,
-            self.bytes,
-            self.is_tcp,
-        ), (self.seed,)
+        return (self.keys, self.vals), (self.seed,)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -83,14 +94,24 @@ class ConntrackTable:
     @classmethod
     def zeros(cls, n_slots: int = DEFAULT_SLOTS, seed: int = 0) -> "ConntrackTable":
         assert n_slots & (n_slots - 1) == 0
-        # Distinct buffers: a shared zeros array would alias leaves and
-        # break jit donation (same buffer donated twice).
-        z = lambda: jnp.zeros((n_slots,), jnp.uint32)
-        return cls(z(), z(), z(), z(), z(), z(), z(), z(), seed=seed)
+        return cls(
+            keys=jnp.zeros((n_slots, 2), jnp.uint32),
+            vals=jnp.zeros((n_slots, 4), jnp.uint32),
+            seed=seed,
+        )
 
     @property
     def n_slots(self) -> int:
-        return int(self.fp_lo.shape[0])
+        return int(self.keys.shape[0])
+
+    # Accumulator views (tests + gc accounting read these).
+    @property
+    def packets(self) -> jnp.ndarray:
+        return self.vals[:, 1]
+
+    @property
+    def bytes(self) -> jnp.ndarray:
+        return self.vals[:, 2]
 
     def process(
         self,
@@ -106,11 +127,13 @@ class ConntrackTable:
         """One fused conntrack pass over a (B,) batch.
 
         Returns (new_table, report_mask (B,) bool, is_reply (B,) bool,
-        report_packets (B,) u32, report_bytes (B,) u32). ``report_mask``
-        marks events that should be emitted downstream; reporting rows carry
-        the connection's packet/byte totals accumulated since its previous
-        report (the reference's conntrackmetadata payload, conntrack.c:15-31),
-        and those slot accumulators then reset.
+        report_packets (B,) u32, report_bytes (B,) u32) — rows in
+        fingerprint-sorted order (a set, not positionally aligned with the
+        input). Reporting rows carry the connection's packet/byte totals
+        accumulated since its previous report (the reference's
+        conntrackmetadata payload, conntrack.c:15-31) including this
+        batch's contribution, and those slots' accumulators then reset.
+        ``now_s`` is the batch timestamp (scalar or broadcastable).
         """
         s = self.n_slots
         # Order-independent key: same connection regardless of direction;
@@ -125,77 +148,92 @@ class ConntrackTable:
         key_cols = [a_ip, b_ip, (a_pt << 16) | b_pt, proto]
         fp_lo = hash_cols(key_cols, np.uint32(self.seed) * 2 + 0xC7)
         fp_hi = hash_cols(key_cols, np.uint32(self.seed) * 2 + 0xC8)
-        slot = reduce_range(fp_lo ^ fp_hi, s).astype(jnp.int32)
+        slot = reduce_range(fp_lo ^ fp_hi, s)
 
-        # ---- within-batch first-occurrence (sort-based dedup) ----
-        # Lexicographic over (fp_lo, fp_hi): sorting fp_lo alone would mark
-        # interleaved fp_lo-colliding connections "first" more than once.
-        b = src_ip.shape[0]
-        order = jnp.lexsort((fp_hi, fp_lo))
-        sorted_fp = fp_lo[order]
-        sorted_hi = fp_hi[order]
-        is_first_sorted = jnp.concatenate(
-            [
-                jnp.array([True]),
-                (sorted_fp[1:] != sorted_fp[:-1]) | (sorted_hi[1:] != sorted_hi[:-1]),
-            ]
-        )
-        first = jnp.zeros((b,), bool).at[order].set(is_first_sorted)
-
-        # ---- gather resident slot state ----
-        res_lo = self.fp_lo[slot]
-        res_hi = self.fp_hi[slot]
-        same_conn = (res_lo == fp_lo) & (res_hi == fp_hi)
-        lifetime = jnp.where(
-            proto == jnp.uint32(6),
-            jnp.uint32(CT_TCP_LIFETIME),
-            jnp.uint32(CT_NON_TCP_LIFETIME),
-        )
-        expired = (now_s - self.last_seen_s[slot]) > lifetime
-        is_new = (~same_conn) | expired
+        # Masked rows sort to the end (max key) and carry a cleared mask bit.
+        k_lo = jnp.where(mask, fp_lo, jnp.uint32(0xFFFFFFFF))
+        k_hi = jnp.where(mask, fp_hi, jnp.uint32(0xFFFFFFFF))
+        is_tcp_ev = proto == jnp.uint32(6)
         interesting = (tcp_flags & jnp.uint32(TCP_SYN | TCP_FIN | TCP_RST)) > 0
-        interval_up = (now_s - self.last_report_s[slot]) >= jnp.uint32(
+        # attr: flags(0-7) | tcp(8) | src_is_a(9) | mask(10) | interesting(11)
+        attr = (
+            (tcp_flags & jnp.uint32(0xFF))
+            | (is_tcp_ev.astype(jnp.uint32) << 8)
+            | (fwd_order.astype(jnp.uint32) << 9)
+            | (mask.astype(jnp.uint32) << 10)
+            | (interesting.astype(jnp.uint32) << 11)
+        )
+        sk_lo, sk_hi, s_slot, s_attr, s_bytes = jax.lax.sort(
+            (k_lo, k_hi, slot, attr, jnp.where(mask, bytes_, 0)), num_keys=2
+        )
+        s_mask = ((s_attr >> 10) & 1).astype(bool)
+        s_int = ((s_attr >> 11) & 1).astype(bool)
+        s_tcp = ((s_attr >> 8) & 1).astype(bool)
+        s_src_is_a = ((s_attr >> 9) & 1).astype(bool)
+
+        diff = (sk_lo[1:] != sk_lo[:-1]) | (sk_hi[1:] != sk_hi[:-1])
+        first = jnp.concatenate([jnp.array([True]), diff])
+        last = jnp.concatenate([diff, jnp.array([True])]) & s_mask
+
+        ones = jnp.where(s_mask, jnp.uint32(1), jnp.uint32(0))
+        seg_pkts, seg_bytes, seg_int = _seg_scan(first, ones, s_bytes, s_int)
+
+        # ---- resident slot state: two row-gathers ----
+        gi = s_slot.astype(jnp.int32)
+        krow = self.keys[gi]  # (B, 2)
+        vrow = self.vals[gi]  # (B, 4)
+        same_conn = (krow[:, 0] == sk_lo) & (krow[:, 1] == sk_hi)
+        meta = vrow[:, 0]
+        seen16 = meta & jnp.uint32(0xFFFF)
+        rep14 = (meta >> 16) & jnp.uint32(0x3FFF)
+        init_a = ((meta >> 30) & 1).astype(bool)
+
+        now16 = (now_s & jnp.uint32(0xFFFF)).astype(jnp.uint32)
+        now14 = (now_s & jnp.uint32(0x3FFF)).astype(jnp.uint32)
+        lifetime = jnp.where(
+            s_tcp, jnp.uint32(CT_TCP_LIFETIME), jnp.uint32(CT_NON_TCP_LIFETIME)
+        )
+        idle = (now16 - seen16) & jnp.uint32(0xFFFF)
+        expired = idle > lifetime
+        is_new = (~same_conn) | expired
+        interval_up = ((now14 - rep14) & jnp.uint32(0x3FFF)) >= jnp.uint32(
             CT_REPORT_INTERVAL
         )
-        report = mask & first & (interesting | is_new | (same_conn & interval_up))
-        is_reply = same_conn & (~expired) & (self.initiator_ip[slot] != src_ip)
+        report = last & (seg_int | is_new | (same_conn & interval_up))
+        is_reply = s_mask & same_conn & (~expired) & (init_a != s_src_is_a)
 
-        # ---- scatter updates (masked rows routed OOB and dropped) ----
-        eff_slot = jnp.where(mask, slot, s)
-        tbl = self
-        # 1. Accumulate this batch's packets/bytes into the slots.
-        pkt_acc = tbl.packets.at[eff_slot].add(
-            jnp.where(mask, 1, 0).astype(jnp.uint32), mode="drop"
+        # New/expired connections must not inherit the evicted resident's
+        # accumulators in their payload (the stale slot counts belong to a
+        # different 5-tuple).
+        res_pkts = jnp.where(is_new, 0, vrow[:, 1])
+        res_bytes = jnp.where(is_new, 0, vrow[:, 2])
+        report_packets = jnp.where(report, res_pkts + seg_pkts, 0).astype(
+            jnp.uint32
         )
-        byte_acc = tbl.bytes.at[eff_slot].add(
-            jnp.where(mask, bytes_, 0).astype(jnp.uint32), mode="drop"
+        report_bytes = jnp.where(report, res_bytes + seg_bytes, 0).astype(
+            jnp.uint32
         )
-        # 2. Reporting rows read the accumulated totals (their payload)...
-        report_packets = jnp.where(report, pkt_acc[slot], 0).astype(jnp.uint32)
-        report_bytes = jnp.where(report, byte_acc[slot], 0).astype(jnp.uint32)
-        # 3. ...and those slots' accumulators reset for the next interval.
-        report_reset = (
-            jnp.zeros((s,), bool)
-            .at[jnp.where(report, slot, s)]
-            .set(True, mode="drop")
+
+        # ---- update rows (last row per connection): two row-scatters ----
+        new_meta = (
+            now16
+            | (jnp.where(report, now14, rep14) << 16)
+            | (jnp.where(is_new, s_src_is_a, init_a).astype(jnp.uint32) << 30)
+            | (s_tcp.astype(jnp.uint32) << 31)
         )
-        new = dataclasses.replace(
-            tbl,
-            fp_lo=tbl.fp_lo.at[eff_slot].set(fp_lo, mode="drop"),
-            fp_hi=tbl.fp_hi.at[eff_slot].set(fp_hi, mode="drop"),
-            last_seen_s=tbl.last_seen_s.at[eff_slot].set(now_s, mode="drop"),
-            is_tcp=tbl.is_tcp.at[eff_slot].set(
-                (proto == jnp.uint32(6)).astype(jnp.uint32), mode="drop"
+        acc_pkts = jnp.where(report, 0, res_pkts + seg_pkts)
+        acc_bytes = jnp.where(report, 0, res_bytes + seg_bytes)
+        eff = jnp.where(last, s_slot, jnp.uint32(s))
+        new_keys = self.keys.at[eff].set(
+            jnp.stack([sk_lo, sk_hi], axis=1), mode="drop"
+        )
+        new_vals = self.vals.at[eff].set(
+            jnp.stack(
+                [new_meta, acc_pkts, acc_bytes, jnp.zeros_like(new_meta)], axis=1
             ),
-            initiator_ip=tbl.initiator_ip.at[
-                jnp.where(mask & is_new, slot, s)
-            ].set(src_ip, mode="drop"),
-            last_report_s=tbl.last_report_s.at[
-                jnp.where(report, slot, s)
-            ].set(now_s, mode="drop"),
-            packets=jnp.where(report_reset, jnp.uint32(0), pkt_acc),
-            bytes=jnp.where(report_reset, jnp.uint32(0), byte_acc),
+            mode="drop",
         )
+        new = dataclasses.replace(self, keys=new_keys, vals=new_vals)
         return new, report, is_reply, report_packets, report_bytes
 
     def active_connections(self, now_s: int) -> jnp.ndarray:
@@ -203,11 +241,12 @@ class ConntrackTable:
 
         Uses the same per-protocol lifetimes as process()'s expiry rule.
         """
-        live = (self.fp_lo | self.fp_hi) != 0
+        live = (self.keys[:, 0] | self.keys[:, 1]) != 0
+        meta = self.vals[:, 0]
+        seen16 = meta & jnp.uint32(0xFFFF)
+        is_tcp = (meta >> 31) > 0
         lifetime = jnp.where(
-            self.is_tcp > 0,
-            jnp.uint32(CT_TCP_LIFETIME),
-            jnp.uint32(CT_NON_TCP_LIFETIME),
+            is_tcp, jnp.uint32(CT_TCP_LIFETIME), jnp.uint32(CT_NON_TCP_LIFETIME)
         )
-        fresh = (jnp.uint32(now_s) - self.last_seen_s) <= lifetime
-        return jnp.sum(live & fresh)
+        idle = (jnp.uint32(now_s) - seen16) & jnp.uint32(0xFFFF)
+        return jnp.sum(live & (idle <= lifetime))

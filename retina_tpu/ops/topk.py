@@ -39,24 +39,28 @@ from retina_tpu.ops.countmin import CountMinSketch
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class TopKTable:
-    """Candidate table: (C, S) key columns + (S,) estimated counts."""
+    """Candidate table: (S, C) key rows + (S,) estimated counts.
 
-    key_cols: jnp.ndarray  # (C, S) uint32
+    Keys are row-major so the winner write is ONE (B, C) row-scatter
+    (contiguous minor dim = one line per winning event) instead of C
+    separate column scatters."""
+
+    key_rows: jnp.ndarray  # (S, C) uint32
     counts: jnp.ndarray  # (S,) uint32
     seed: int = 0
 
     def tree_flatten(self):
-        return (self.key_cols, self.counts), (self.seed,)
+        return (self.key_rows, self.counts), (self.seed,)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(key_cols=children[0], counts=children[1], seed=aux[0])
+        return cls(key_rows=children[0], counts=children[1], seed=aux[0])
 
     @classmethod
     def zeros(cls, n_key_cols: int, n_slots: int = 1 << 11, seed: int = 0):
         assert n_slots & (n_slots - 1) == 0
         return cls(
-            key_cols=jnp.zeros((n_key_cols, n_slots), jnp.uint32),
+            key_rows=jnp.zeros((n_slots, n_key_cols), jnp.uint32),
             counts=jnp.zeros((n_slots,), jnp.uint32),
             seed=seed,
         )
@@ -80,15 +84,11 @@ class TopKTable:
         # est>0 excludes padding rows (their estimate is forced to 0).
         win = (est == slot_now) & (est > 0)
         safe_slot = jnp.where(win, slot, jnp.uint32(s))  # OOB rows dropped
-        new_keys = self.key_cols
-        cols = jnp.stack(key_cols).astype(jnp.uint32)  # (C, B)
-        new_keys = new_keys.at[:, safe_slot].set(
-            jnp.where(win[None, :], cols, 0), mode="drop"
-        )
-        # Keep old keys where no winner landed: scatter wrote zeros for
-        # non-winning lanes only at slot S (dropped); winning lanes with
-        # equal estimates may race, but all carry valid keys of equal count.
-        return dataclasses.replace(self, key_cols=new_keys, counts=new_counts)
+        rows = jnp.stack(key_cols, axis=1).astype(jnp.uint32)  # (B, C)
+        new_keys = self.key_rows.at[safe_slot].set(rows, mode="drop")
+        # Winning lanes with equal estimates may race, but all carry valid
+        # keys of equal count — either is a correct candidate.
+        return dataclasses.replace(self, key_rows=new_keys, counts=new_counts)
 
     def top_k_host(self, k: int) -> tuple[np.ndarray, np.ndarray]:
         """Host-side reconciliation: returns (keys (k, C), counts (k,)).
@@ -97,7 +97,7 @@ class TopKTable:
         the scrape-time path, off the device hot loop.
         """
         counts = np.asarray(self.counts)
-        keys = np.asarray(self.key_cols).T  # (S, C)
+        keys = np.asarray(self.key_rows)  # (S, C)
         order = np.argsort(counts)[::-1][:k]
         sel = counts[order] > 0
         return keys[order][sel], counts[order][sel]
@@ -105,7 +105,7 @@ class TopKTable:
     def reset(self) -> "TopKTable":
         return dataclasses.replace(
             self,
-            key_cols=jnp.zeros_like(self.key_cols),
+            key_rows=jnp.zeros_like(self.key_rows),
             counts=jnp.zeros_like(self.counts),
         )
 

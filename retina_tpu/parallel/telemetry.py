@@ -193,8 +193,8 @@ class ShardedTelemetry:
 
             def hh_gather(hh):
                 return {
-                    # (D, C, S) and (D, S): union of per-device candidates.
-                    "keys": gather(hh.table.key_cols),
+                    # (D, S, C) and (D, S): union of per-device candidates.
+                    "keys": gather(hh.table.key_rows),
                     "counts": gather(hh.table.counts),
                 }
 
@@ -249,10 +249,10 @@ def topk_from_snapshot(
     group-sum is a no-op.
     """
     hh = snap[name]
-    keys = np.asarray(hh["keys"])  # (D, C, S)
+    keys = np.asarray(hh["keys"])  # (D, S, C)
     counts = np.asarray(hh["counts"])  # (D, S)
-    d, c, sl = keys.shape
-    flat_keys = np.moveaxis(keys, 1, 2).reshape(d * sl, c)
+    d, sl, c = keys.shape
+    flat_keys = keys.reshape(d * sl, c)
     flat_counts = counts.reshape(d * sl).astype(np.uint64)
     nonzero = flat_counts > 0
     flat_keys, flat_counts = flat_keys[nonzero], flat_counts[nonzero]
