@@ -113,11 +113,11 @@ class FakeEngine:
             "hll_flows": np.array([42.0]),
             "hll_src_per_reason": z((16,), np.float32),
             "hll_src_per_pod": z((n_pods,), np.float32),
-            "flow_hh": {"keys": z((1, 4, 8), np.uint32),
+            "flow_hh": {"keys": z((1, 8, 4), np.uint32),
                         "counts": z((1, 8), np.uint32)},
-            "svc_hh": {"keys": z((1, 2, 8), np.uint32),
+            "svc_hh": {"keys": z((1, 8, 2), np.uint32),
                        "counts": z((1, 8), np.uint32)},
-            "dns_hh": {"keys": z((1, 1, 8), np.uint32),
+            "dns_hh": {"keys": z((1, 8, 1), np.uint32),
                        "counts": z((1, 8), np.uint32)},
             "active_conns": np.uint32(0),
         }
@@ -195,7 +195,7 @@ def test_reconcile_resets_advanced_registry():
 def test_flows_and_distinct_sources_publish():
     eng = FakeEngine()
     # one heavy flow candidate on device 0 slot 0
-    eng.snap["flow_hh"]["keys"][0, :, 0] = (
+    eng.snap["flow_hh"]["keys"][0, 0, :] = (
         ip_to_u32("10.0.0.9"), ip_to_u32("10.0.0.1"),
         (1234 << 16) | 80, 6,
     )
