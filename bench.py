@@ -6,6 +6,15 @@ path that replaces the reference's single-threaded Go ProcessFlow loop
 SURVEY.md §3.2) — on a 1M-event Zipf replay (BASELINE config 2), plus
 heavy-hitter recall vs exact ground truth.
 
+Hardened per round-1 verdict:
+- stage progress to stderr (devices, state init, compile seconds, steps);
+- transient device/compile failures (UNAVAILABLE remote_compile) retried
+  with exponential backoff;
+- ``--smoke`` runs reduced shapes and finishes in well under a minute;
+- ALWAYS prints exactly one JSON line on stdout, even on failure — then
+  carrying an "error" field so the driver records a diagnosis instead of
+  an empty file.
+
 Prints ONE JSON line:
   {"metric": "flow_events_per_sec_per_chip", "value": N, "unit": "events/s",
    "vs_baseline": value / 10e6}
@@ -16,13 +25,46 @@ numbers, so the target is the baseline).
 
 from __future__ import annotations
 
+import argparse
 import json
+import sys
 import time
+import traceback
 
 import numpy as np
 
 
-def main() -> None:
+def log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - T0:8.2f}s] {msg}", file=sys.stderr, flush=True)
+
+
+T0 = time.perf_counter()
+
+
+def retry(fn, what: str, attempts: int = 4, base_delay: float = 2.0):
+    """Run fn(); retry transient runtime failures (remote_compile hiccups,
+    UNAVAILABLE) with exponential backoff. Re-raises on the last attempt or
+    on non-transient errors."""
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — inspect and re-raise below
+            name = type(e).__name__
+            text = f"{name}: {e}"
+            transient = any(
+                s in text
+                for s in ("UNAVAILABLE", "Connection refused", "Connection Failed",
+                          "DEADLINE_EXCEEDED", "transport")
+            )
+            if not transient or i == attempts - 1:
+                raise
+            delay = base_delay * (2 ** i)
+            log(f"{what}: transient failure ({text.splitlines()[0][:160]}); "
+                f"retry {i + 1}/{attempts - 1} in {delay:.0f}s")
+            time.sleep(delay)
+
+
+def run(smoke: bool) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -30,29 +72,74 @@ def main() -> None:
     from retina_tpu.models.identity import IdentityMap
     from retina_tpu.models.pipeline import PipelineConfig, TelemetryPipeline
 
-    batch = 1 << 17  # 131,072 events/step, 8 MiB of records
-    n_batches = 8  # 1M-event replay
-    timed_steps = 24
+    out: dict = {
+        "metric": "flow_events_per_sec_per_chip",
+        "value": 0,
+        "unit": "events/s",
+        "vs_baseline": 0.0,
+        "extra": {"smoke": smoke},
+    }
 
-    cfg = PipelineConfig()  # production shapes (2^18-slot conntrack, etc.)
+    devs = retry(jax.devices, "acquire devices")
+    log(f"devices acquired: {devs} (backend={jax.default_backend()})")
+    out["extra"]["backend"] = jax.default_backend()
+
+    if smoke:
+        batch = 1 << 14
+        n_batches = 4
+        timed_steps = 8
+        cfg = PipelineConfig(
+            n_pods=256, cms_width=1 << 12, topk_slots=1 << 8,
+            conntrack_slots=1 << 12, latency_slots=1 << 8,
+            entropy_buckets=1 << 8,
+        )
+        n_flows, n_pods_gen = 50_000, 256
+    else:
+        batch = 1 << 17  # 131,072 events/step, 8 MiB of records
+        n_batches = 8  # 1M-event replay
+        timed_steps = 24
+        cfg = PipelineConfig()  # production shapes (2^18-slot conntrack, etc.)
+        n_flows, n_pods_gen = 1_000_000, 2048
+
     pipeline = TelemetryPipeline(cfg)
     step = pipeline.jitted_step()
 
-    gen = TrafficGen(n_flows=1_000_000, n_pods=2048, seed=42)
+    log(f"generating traffic: {n_flows} flows, batch={batch}, "
+        f"{n_batches} batches")
+    gen = TrafficGen(n_flows=n_flows, n_pods=n_pods_gen, seed=42)
     ident = IdentityMap.build_host(
-        {0x0A000000 + i: i for i in range(1, 2048)}, n_slots=1 << 16
+        {0x0A000000 + i: i for i in range(1, n_pods_gen)},
+        n_slots=1 << (10 if smoke else 16),
     )
     host_batches = [gen.batch(batch) for i in range(n_batches)]
-    dev_batches = [jax.device_put(b) for b in host_batches]
+    dev_batches = retry(
+        lambda: [jax.device_put(b) for b in host_batches], "device_put"
+    )
     n_valid = jnp.uint32(batch)
     api_ip = jnp.uint32(0)
 
-    state = pipeline.init_state()
-    # Warmup: compile + first touch.
-    state, _ = step(state, dev_batches[0], n_valid, jnp.uint32(1), ident, api_ip)
-    state, _ = step(state, dev_batches[1], n_valid, jnp.uint32(1), ident, api_ip)
+    log("state init")
+    state = retry(pipeline.init_state, "init_state")
+
+    log("compile start (jit first call)")
+    tc = time.perf_counter()
+
+    def warmup():
+        s, _ = step(state, dev_batches[0], n_valid, jnp.uint32(1), ident, api_ip)
+        jax.block_until_ready(s.totals)
+        return s
+
+    state = retry(warmup, "compile+warmup")
+    compile_s = time.perf_counter() - tc
+    log(f"compile end: {compile_s:.1f}s")
+    out["extra"]["compile_seconds"] = round(compile_s, 2)
+
+    # Second warm step (steady-state cache touch).
+    state, _ = step(state, dev_batches[1], n_valid, jnp.uint32(1),
+                    ident, api_ip)
     jax.block_until_ready(state.totals)
 
+    log(f"timed loop: {timed_steps} steps")
     t0 = time.perf_counter()
     for i in range(timed_steps):
         state, _ = step(
@@ -66,10 +153,18 @@ def main() -> None:
     jax.block_until_ready(state.totals)
     dt = time.perf_counter() - t0
     events_per_sec = timed_steps * batch / dt
+    log(f"timed loop done: {dt * 1e3 / timed_steps:.2f} ms/step, "
+        f"{events_per_sec / 1e6:.2f}M ev/s")
+
+    out["value"] = round(events_per_sec)
+    out["vs_baseline"] = round(events_per_sec / 10_000_000, 4)
+    out["extra"]["batch"] = batch
+    out["extra"]["timed_steps"] = timed_steps
+    out["extra"]["step_ms"] = round(dt * 1e3 / timed_steps, 3)
+    out["extra"]["events_total"] = int(np.asarray(state.totals)[0])
 
     # Heavy-hitter recall@k vs exact ground truth (BASELINE config 2).
-    from retina_tpu.events.schema import F
-
+    log("heavy-hitter recall readback")
     k = 50
     keys, _ = state.flow_hh.table.top_k_host(256)
     reported = {tuple(kk) for kk in keys}
@@ -84,24 +179,30 @@ def main() -> None:
         )
         hits += key in reported
     recall = hits / k
+    out["extra"]["heavy_hitter_recall_at_50"] = recall
+    log(f"recall@50 = {recall}")
+    return out
 
-    print(
-        json.dumps(
-            {
-                "metric": "flow_events_per_sec_per_chip",
-                "value": round(events_per_sec),
-                "unit": "events/s",
-                "vs_baseline": round(events_per_sec / 10_000_000, 4),
-                "extra": {
-                    "heavy_hitter_recall_at_50": recall,
-                    "batch": batch,
-                    "timed_steps": timed_steps,
-                    "backend": jax.default_backend(),
-                    "events_total": int(np.asarray(state.totals)[0]),
-                },
-            }
-        )
-    )
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="reduced shapes, completes in <60s")
+    args = ap.parse_args()
+    try:
+        out = run(args.smoke)
+    except Exception as e:  # noqa: BLE001 — always emit the JSON line
+        log("FAILED:\n" + traceback.format_exc())
+        out = {
+            "metric": "flow_events_per_sec_per_chip",
+            "value": 0,
+            "unit": "events/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}".splitlines()[0][:400],
+        }
+    print(json.dumps(out), flush=True)
+    if "error" in out:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

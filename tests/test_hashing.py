@@ -51,3 +51,31 @@ def test_reduce_range_power_of_two_only():
 
     with pytest.raises(AssertionError):
         reduce_range(jnp.arange(4, dtype=jnp.uint32), 300)
+
+
+def test_numpy_mirror_parity():
+    # Host-side table builders rely on bit-identical numpy mirrors of the
+    # device hash chain (models/identity.py churn path).
+    from retina_tpu.ops.hashing import (
+        fmix32_np,
+        hash_cols_np,
+        reduce_range_np,
+        fmix32,
+    )
+
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 2**32, 50_000, dtype=np.uint32)
+    assert (np.asarray(fmix32(jnp.asarray(x))) == fmix32_np(x)).all()
+    for seed in (1, 0x1DE47, 0xB0A711, 9999):
+        dev = np.asarray(hash_cols([jnp.asarray(x)], np.uint32(seed)))
+        host = hash_cols_np([x], np.uint32(seed))
+        assert (dev == host).all()
+    dev2 = np.asarray(
+        hash_cols([jnp.asarray(x), jnp.asarray(x[::-1].copy())], 7)
+    )
+    host2 = hash_cols_np([x, x[::-1].copy()], 7)
+    assert (dev2 == host2).all()
+    assert (
+        np.asarray(reduce_range(hash_cols([jnp.asarray(x)], 5), 1 << 12))
+        == reduce_range_np(hash_cols_np([x], 5), 1 << 12)
+    ).all()
