@@ -48,6 +48,26 @@ from retina_tpu.ops.hyperloglog import HyperLogLog
 from retina_tpu.ops.topk import HeavyHitterSketch
 
 
+def _sum64(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact (lo, hi) u32 limbs of sum(x) for a (B,) uint32 batch.
+
+    TPU has no u64 and a direct u32 sum wraps (per-connection report
+    accumulators reach 2^32-1, so even two reports can overflow). Summing
+    the four 8-bit byte planes keeps every partial sum < 2^25 * B exact in
+    u32, then the planes are recombined with explicit carries.
+    """
+    p0 = jnp.sum(x & jnp.uint32(0xFF)).astype(jnp.uint32)
+    p1 = jnp.sum((x >> 8) & jnp.uint32(0xFF)).astype(jnp.uint32)
+    p2 = jnp.sum((x >> 16) & jnp.uint32(0xFF)).astype(jnp.uint32)
+    p3 = jnp.sum(x >> 24).astype(jnp.uint32)
+    hi = (p1 >> 24) + (p2 >> 16) + (p3 >> 8)
+    lo = p0
+    for t in (p1 << 8, p2 << 16, p3 << 24):
+        lo = lo + t
+        hi = hi + (lo < t).astype(jnp.uint32)
+    return lo, hi
+
+
 @dataclasses.dataclass(frozen=True)
 class PipelineConfig:
     """Static shapes of every aggregator (hashable; part of the jit key)."""
@@ -79,6 +99,33 @@ class PipelineConfig:
     # an entry in the explicit filter map are masked out of every
     # aggregator. bypass_filter=True admits everything.
     bypass_filter: bool = True
+    # DataAggregationLevel (reference config.go:16-23, compiled into the
+    # datapath via dynamic.h and consumed at packetparser.c:214-225): at
+    # "low", the packet-stream sketches (flow_hh, svc_hh, hll_flows,
+    # entropy) do NOT take per-packet updates; only conntrack REPORT rows
+    # feed them (SYN/FIN/RST or the 30s per-connection interval),
+    # weighted by the accumulated packet totals the report carries — the
+    # sketch traffic collapses from per-packet to per-connection just as
+    # the reference's packetparser event stream does. dns_hh and the
+    # drop-reason HLL stay per-event in both modes: in the reference,
+    # DATA_AGGREGATION_LEVEL gates only packetparser.c — the dns and
+    # dropreason plugins are separate programs it never touches. Dense
+    # exact rectangles and node counters stay per-packet in both modes
+    # (bounded and cheap). Requires enable_conntrack; validated in
+    # __post_init__.
+    data_aggregation_level: str = "high"
+
+    def __post_init__(self):
+        if self.data_aggregation_level not in ("low", "high"):
+            raise ValueError(
+                f"data_aggregation_level must be low|high, "
+                f"got {self.data_aggregation_level!r}"
+            )
+        if self.data_aggregation_level == "low" and not self.enable_conntrack:
+            raise ValueError(
+                "data_aggregation_level=low requires enable_conntrack "
+                "(reports drive the sketch sampling)"
+            )
 
 
 @jax.tree_util.register_pytree_node_class
@@ -95,6 +142,11 @@ class PipelineState:
     node_counters: jnp.ndarray  # (2 dir, 2 {pkts, bytes}) uint32, node-level
     totals: jnp.ndarray  # (8,) uint32: [events, fwd, drop, dnsreq, dnsresp,
     #                                    retrans, ct_reports, lost]
+    # Cumulative conntrack-reported packet/byte totals as two u32 limbs
+    # each (TPU has no u64; manual carry): [pkts_lo, pkts_hi, bytes_lo,
+    # bytes_hi]. Feeds the conntrack GC accounting pass (the reference GC
+    # iterates the map and sums conntrackmetadata, conntrack_linux.go:95+).
+    ct_totals: jnp.ndarray  # (4,) uint32
     # Sketches (remote-context mode).
     flow_hh: HeavyHitterSketch  # 5-tuple heavy hitters
     svc_hh: HeavyHitterSketch  # (src_pod, dst_pod) service graph
@@ -136,6 +188,7 @@ class TelemetryPipeline:
             pod_retrans=u(c.n_pods),
             node_counters=u(2, 2),
             totals=u(8),
+            ct_totals=u(4),
             flow_hh=HeavyHitterSketch.zeros(
                 4, c.cms_depth, c.cms_width, c.topk_slots, seed=1
             ),
@@ -216,6 +269,20 @@ class TelemetryPipeline:
 
         w_pkts = jnp.where(is_fwd, packets, 0)
         w_bytes = jnp.where(is_fwd, bytes_, 0)
+
+        # ---- conntrack sampling (before the sketches: low aggregation
+        # gates sketch updates on the report decisions) ----
+        ct = state.conntrack
+        n_reports = jnp.uint32(0)
+        report = jnp.zeros((b,), bool)
+        rep_pkts = jnp.zeros((b,), jnp.uint32)
+        rep_bytes = jnp.zeros((b,), jnp.uint32)
+        if c.enable_conntrack:
+            ct, report, _, rep_pkts, rep_bytes = ct.process(
+                src_ip, dst_ip, ports, proto, tcp_flags, now_s, bytes_, mask,
+                packets_=packets,
+            )
+            n_reports = jnp.sum(report).astype(jnp.uint32)
 
         # ---- dense rectangles ----
         # Every rectangle updates through ONE row-scatter with the counter
@@ -302,39 +369,45 @@ class TelemetryPipeline:
         ).astype(jnp.uint32)
 
         # ---- sketches ----
+        # At low aggregation, sketch updates ride the conntrack reports:
+        # one weighted update per reporting connection (carrying the
+        # accumulated packet count since its last report, all verdicts)
+        # instead of one per packet — the documented low-mode semantics.
+        low = c.data_aggregation_level == "low"
         five = [src_ip, dst_ip, ports, proto]
-        flow_hh = state.flow_hh.update(five, jnp.where(is_fwd, packets, 0))
-        svc_w = jnp.where(is_fwd & (src_pod > 0) & (dst_pod > 0), packets, 0)
+        flow_w = rep_pkts if low else jnp.where(is_fwd, packets, 0)
+        flow_hh = state.flow_hh.update(five, flow_w)
+        pods_known = (src_pod > 0) & (dst_pod > 0)
+        svc_w = jnp.where(
+            pods_known, rep_pkts if low else jnp.where(is_fwd, packets, 0), 0
+        )
         svc_hh = state.svc_hh.update([src_pod, dst_pod], svc_w)
         dns_hh = state.dns_hh.update(
             [col(F.DNS_QHASH)], jnp.where(is_dns_req, 1, 0).astype(jnp.uint32)
         )
 
-        hll_flows = state.hll_flows.update(five, jnp.zeros_like(src_ip), mask)
+        sk_mask = report if low else mask
+        hll_flows = state.hll_flows.update(
+            five, jnp.zeros_like(src_ip), sk_mask
+        )
         hll_reason = state.hll_src_per_reason.update([src_ip], reason, is_drop)
         hll_pod = state.hll_src_per_pod.update(
-            [src_ip], jnp.minimum(dst_pod, jnp.uint32(c.n_pods - 1)), is_ingress & mask
+            [src_ip],
+            jnp.minimum(dst_pod, jnp.uint32(c.n_pods - 1)),
+            is_ingress & sk_mask,
         )
 
-        ones = jnp.where(mask, 1.0, 0.0)
+        ones = (
+            rep_pkts.astype(jnp.float32)
+            if low
+            else jnp.where(mask, 1.0, 0.0)
+        )
         ent = state.entropy
         ent = ent.update([src_ip], jnp.zeros_like(src_ip), ones)
         ent = ent.update([dst_ip], jnp.ones_like(src_ip), ones)
         ent = ent.update(
             [ports & jnp.uint32(0xFFFF)], jnp.full_like(src_ip, 2), ones
         )
-
-        # ---- conntrack sampling ----
-        ct = state.conntrack
-        n_reports = jnp.uint32(0)
-        report = jnp.zeros((b,), bool)
-        rep_pkts = jnp.zeros((b,), jnp.uint32)
-        rep_bytes = jnp.zeros((b,), jnp.uint32)
-        if c.enable_conntrack:
-            ct, report, _, rep_pkts, rep_bytes = ct.process(
-                src_ip, dst_ip, ports, proto, tcp_flags, now_s, bytes_, mask
-            )
-            n_reports = jnp.sum(report).astype(jnp.uint32)
 
         # ---- apiserver latency (reference latency.go:286-301: match
         # TSval of outgoing apiserver packets to TSecr of replies) ----
@@ -369,6 +442,23 @@ class TelemetryPipeline:
                 jnp.where(hit, 1, 0).astype(jnp.uint32), mode="drop"
             )
 
+        # 64-bit (two-limb) accumulation of reported packets/bytes; exact
+        # byte-plane sums — per-connection report accumulators are full
+        # u32, so a plain batch sum could wrap before the carry applies.
+        rp_lo, rp_hi = _sum64(rep_pkts)
+        rb_lo, rb_hi = _sum64(rep_bytes)
+        ctt = state.ct_totals
+        lo_p = ctt[0] + rp_lo
+        lo_b = ctt[2] + rb_lo
+        ct_totals = jnp.stack(
+            [
+                lo_p,
+                ctt[1] + rp_hi + (lo_p < rp_lo).astype(jnp.uint32),
+                lo_b,
+                ctt[3] + rb_hi + (lo_b < rb_lo).astype(jnp.uint32),
+            ]
+        )
+
         n_mask = jnp.sum(mask).astype(jnp.uint32)
         totals = state.totals + jnp.stack(
             [
@@ -391,6 +481,7 @@ class TelemetryPipeline:
             pod_retrans=pret,
             node_counters=nc,
             totals=totals,
+            ct_totals=ct_totals,
             flow_hh=flow_hh,
             svc_hh=svc_hh,
             dns_hh=dns_hh,

@@ -29,9 +29,11 @@ long chain of B-sized gathers/scatters (the measured cost on TPU is the
   behavior), zero control flow.
 
 Report decisions and update scatters happen on each connection's LAST row
-in sorted order; returned report masks/payloads are therefore in sorted
-order, which downstream consumers treat as a set (engine.py ignores row
-order; flow export reads only reporting rows).
+in sorted order; the original event index rides along as a sort payload so
+returned report masks/payloads are scattered back to ORIGINAL batch order
+(one extra row-scatter) — downstream consumers (low-aggregation sketch
+gating in models/pipeline.py, conntrack-sampled flow export) need report
+decisions aligned with the event columns.
 """
 
 from __future__ import annotations
@@ -50,6 +52,11 @@ CT_REPORT_INTERVAL = 30
 CT_TCP_LIFETIME = 360
 CT_NON_TCP_LIFETIME = 60
 DEFAULT_SLOTS = 1 << 18  # 262,144, matching the reference map size
+# Wrap-aware idle deltas read a FUTURE last_seen (feed thread stamped a
+# later second than the reader's clock — racy but legal across threads)
+# as ~0xFFFF idle. Deltas in the top slack band are clock skew, not
+# 18-hour idleness; treat them as fresh.
+CLOCK_SKEW_SLACK = 256
 
 
 def _seg_scan(first: jnp.ndarray, *values: jnp.ndarray):
@@ -123,17 +130,21 @@ class ConntrackTable:
         now_s: jnp.ndarray,
         bytes_: jnp.ndarray,
         mask: jnp.ndarray,
+        packets_: jnp.ndarray | None = None,
     ) -> tuple["ConntrackTable", jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """One fused conntrack pass over a (B,) batch.
 
         Returns (new_table, report_mask (B,) bool, is_reply (B,) bool,
-        report_packets (B,) u32, report_bytes (B,) u32) — rows in
-        fingerprint-sorted order (a set, not positionally aligned with the
-        input). Reporting rows carry the connection's packet/byte totals
-        accumulated since its previous report (the reference's
-        conntrackmetadata payload, conntrack.c:15-31) including this
-        batch's contribution, and those slots' accumulators then reset.
-        ``now_s`` is the batch timestamp (scalar or broadcastable).
+        report_packets (B,) u32, report_bytes (B,) u32) — aligned with the
+        INPUT batch order (each connection's report lands on its last
+        event row in the batch). Reporting rows carry the connection's
+        packet/byte totals accumulated since its previous report (the
+        reference's conntrackmetadata payload, conntrack.c:15-31)
+        including this batch's contribution, and those slots' accumulators
+        then reset. ``now_s`` is the batch timestamp (scalar or
+        broadcastable). ``packets_`` is the per-event packet count column
+        for pre-aggregated sources (F.PACKETS); None counts each event
+        row as one packet (the reference's per-packet kernel view).
         """
         s = self.n_slots
         # Order-independent key: same connection regardless of direction;
@@ -163,8 +174,20 @@ class ConntrackTable:
             | (mask.astype(jnp.uint32) << 10)
             | (interesting.astype(jnp.uint32) << 11)
         )
-        sk_lo, sk_hi, s_slot, s_attr, s_bytes = jax.lax.sort(
-            (k_lo, k_hi, slot, attr, jnp.where(mask, bytes_, 0)), num_keys=2
+        b = src_ip.shape[0]
+        if packets_ is None:
+            packets_ = jnp.ones((b,), jnp.uint32)
+        sk_lo, sk_hi, s_slot, s_attr, s_bytes, s_pkts, s_idx = jax.lax.sort(
+            (
+                k_lo,
+                k_hi,
+                slot,
+                attr,
+                jnp.where(mask, bytes_, 0),
+                jnp.where(mask, packets_, 0),
+                jnp.arange(b, dtype=jnp.uint32),
+            ),
+            num_keys=2,
         )
         s_mask = ((s_attr >> 10) & 1).astype(bool)
         s_int = ((s_attr >> 11) & 1).astype(bool)
@@ -175,8 +198,7 @@ class ConntrackTable:
         first = jnp.concatenate([jnp.array([True]), diff])
         last = jnp.concatenate([diff, jnp.array([True])]) & s_mask
 
-        ones = jnp.where(s_mask, jnp.uint32(1), jnp.uint32(0))
-        seg_pkts, seg_bytes, seg_int = _seg_scan(first, ones, s_bytes, s_int)
+        seg_pkts, seg_bytes, seg_int = _seg_scan(first, s_pkts, s_bytes, s_int)
 
         # ---- resident slot state: two row-gathers ----
         gi = s_slot.astype(jnp.int32)
@@ -194,10 +216,13 @@ class ConntrackTable:
             s_tcp, jnp.uint32(CT_TCP_LIFETIME), jnp.uint32(CT_NON_TCP_LIFETIME)
         )
         idle = (now16 - seen16) & jnp.uint32(0xFFFF)
-        expired = idle > lifetime
+        expired = (idle > lifetime) & (
+            idle <= jnp.uint32(0xFFFF - CLOCK_SKEW_SLACK)
+        )
         is_new = (~same_conn) | expired
-        interval_up = ((now14 - rep14) & jnp.uint32(0x3FFF)) >= jnp.uint32(
-            CT_REPORT_INTERVAL
+        rep_delta = (now14 - rep14) & jnp.uint32(0x3FFF)
+        interval_up = (rep_delta >= jnp.uint32(CT_REPORT_INTERVAL)) & (
+            rep_delta <= jnp.uint32(0x3FFF - CLOCK_SKEW_SLACK)
         )
         report = last & (seg_int | is_new | (same_conn & interval_up))
         is_reply = s_mask & same_conn & (~expired) & (init_a != s_src_is_a)
@@ -234,7 +259,29 @@ class ConntrackTable:
             mode="drop",
         )
         new = dataclasses.replace(self, keys=new_keys, vals=new_vals)
-        return new, report, is_reply, report_packets, report_bytes
+
+        # Scatter decisions back to original batch positions (one (B, 4)
+        # row-scatter): downstream gating needs alignment with the event
+        # columns, not the sort order.
+        packed = jnp.stack(
+            [
+                report.astype(jnp.uint32),
+                is_reply.astype(jnp.uint32),
+                report_packets,
+                report_bytes,
+            ],
+            axis=1,
+        )
+        orig = jnp.zeros((b, 4), jnp.uint32).at[s_idx.astype(jnp.int32)].set(
+            packed
+        )
+        return (
+            new,
+            orig[:, 0].astype(bool),
+            orig[:, 1].astype(bool),
+            orig[:, 2],
+            orig[:, 3],
+        )
 
     def active_connections(self, now_s: int) -> jnp.ndarray:
         """Count of non-expired resident connections (scrape-time gauge).
@@ -249,4 +296,7 @@ class ConntrackTable:
             is_tcp, jnp.uint32(CT_TCP_LIFETIME), jnp.uint32(CT_NON_TCP_LIFETIME)
         )
         idle = (jnp.uint32(now_s) - seen16) & jnp.uint32(0xFFFF)
-        return jnp.sum(live & (idle <= lifetime))
+        fresh = (idle <= lifetime) | (
+            idle > jnp.uint32(0xFFFF - CLOCK_SKEW_SLACK)
+        )
+        return jnp.sum(live & fresh)

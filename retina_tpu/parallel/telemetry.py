@@ -206,6 +206,10 @@ class ShardedTelemetry:
                 "pod_retrans": psum(s.pod_retrans),
                 "node_counters": psum(s.node_counters),
                 "totals": psum(s.totals),
+                # Two-limb u32 counters cannot psum (a summed lo limb may
+                # wrap and lose the carry) — gather per-device limbs and
+                # reassemble 64-bit values on host (conntrack_gc()).
+                "ct_totals": gather(s.ct_totals),
                 "lat_hist": psum(s.lat_hist),
                 "hll_flows": hll_est(s.hll_flows),
                 "hll_src_per_reason": hll_est(s.hll_src_per_reason),

@@ -121,3 +121,56 @@ def test_udp_expiry_in_active_count():
     tbl, _, _ = _process(tbl, 3, 4, 1000, 80, TCP_ACK, now=100, proto=6)
     # At now=200: UDP (60s lifetime) expired, TCP (360s) still live.
     assert int(tbl.active_connections(200)) == 1
+
+
+def test_report_positions_aligned_with_input_order():
+    """Reports come back in ORIGINAL batch order: each connection's report
+    lands on its last event row (low-aggregation gating and flow export
+    index into the event columns with this mask)."""
+    tbl = ConntrackTable.zeros(1 << 10)
+    # rows: A A B A B  (A = 1->2:1000->80, B = 3->4:2000->443)
+    src = jnp.asarray(np.array([1, 1, 3, 1, 3], np.uint32))
+    dst = jnp.asarray(np.array([2, 2, 4, 2, 4], np.uint32))
+    ports = jnp.asarray(
+        np.array(
+            [
+                pack_ports(1000, 80),
+                pack_ports(1000, 80),
+                pack_ports(2000, 443),
+                pack_ports(1000, 80),
+                pack_ports(2000, 443),
+            ],
+            np.uint32,
+        )
+    )
+    b = 5
+    tbl, rep, _, pk, by = tbl.process(
+        src_ip=src,
+        dst_ip=dst,
+        ports=ports,
+        proto=jnp.full((b,), 6, jnp.uint32),
+        tcp_flags=jnp.full((b,), TCP_ACK, jnp.uint32),
+        now_s=jnp.uint32(100),
+        bytes_=jnp.full((b,), 10, jnp.uint32),
+        mask=jnp.ones((b,), bool),
+    )
+    rep = np.asarray(rep)
+    # Both connections are new -> one report each, on their LAST rows
+    # (index 3 for A, index 4 for B).
+    assert list(rep) == [False, False, False, True, True], rep
+    assert int(pk[3]) == 3 and int(by[3]) == 30  # A: 3 events x 10B
+    assert int(pk[4]) == 2 and int(by[4]) == 20  # B: 2 events
+
+
+def test_future_timestamp_is_clock_skew_not_expiry():
+    """A last_seen one second in the READER's future (feed thread stamped
+    a later second — legal cross-thread race) must not read as ~18h idle:
+    the connection stays live and does not spuriously re-report."""
+    tbl = ConntrackTable.zeros(1 << 10)
+    tbl, rep, _ = _process(tbl, 1, 2, 1000, 80, TCP_ACK, now=101)
+    assert bool(rep[0])  # new conn
+    assert int(tbl.active_connections(100)) == 1  # reader 1s behind
+    # Same skew in process(): within-interval packet at now=100 must not
+    # be treated as a new connection.
+    tbl, rep, _ = _process(tbl, 1, 2, 1000, 80, TCP_ACK, now=100)
+    assert not bool(rep[0])

@@ -6,8 +6,13 @@ _test.go feeds flows through the module loop and asserts metric outcomes).
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
+
+from retina_tpu.events.synthetic import POD_NET
+from test_engine import mk_records
 
 from retina_tpu.events.schema import (
+    F,
     EventBuilder,
     EV_DNS_REQ,
     EV_DROP,
@@ -174,3 +179,129 @@ def test_totals_and_conntrack_reports():
     assert t[0] == 20  # events
     # One connection, first sighting in batch -> exactly 1 conntrack report.
     assert t[6] == 1
+
+
+def test_data_aggregation_level_low_gates_sketches():
+    """data_aggregation_level wiring (reference config.go:16-23 compiled
+    into the datapath at packetparser.c:214-225): at low, sketches grow
+    only on conntrack reports (weighted by accumulated packets); dense
+    rectangles stay exact per-packet in both modes."""
+    import dataclasses as _dc
+
+    from retina_tpu.models.identity import IdentityMap
+
+    base = PipelineConfig(
+        n_pods=64, cms_width=1 << 10, topk_slots=1 << 6,
+        conntrack_slots=1 << 10, latency_slots=1 << 6,
+        entropy_buckets=1 << 8, hll_precision=8,
+    )
+    ident = IdentityMap.build_host({POD_NET + i: i for i in (1, 2)},
+                                   n_slots=1 << 8)
+    # One steady connection pod1->pod2, 64 ACK events per batch, batches
+    # 1 second apart (within the 30s report interval after the first).
+    rec = mk_records(64, src_pods=np.full(64, 1), dst_pods=np.full(64, 2))
+
+    def run(level):
+        cfg = _dc.replace(base, data_aggregation_level=level)
+        pipe = TelemetryPipeline(cfg)
+        step = pipe.jitted_step()
+        state = pipe.init_state()
+        for t in range(3):
+            state, _ = step(
+                state, jnp.asarray(rec), jnp.uint32(64),
+                jnp.uint32(100 + t), ident, jnp.uint32(0),
+            )
+        keys, counts = state.flow_hh.table.top_k_host(4)
+        return state, (int(counts[0]) if len(counts) else 0)
+
+    state_hi, hh_hi = run("high")
+    state_lo, hh_lo = run("low")
+    # High: every forwarded packet counted (3 x 64). Low: only the first
+    # batch's new-connection report counted (64 accumulated packets);
+    # batches 2-3 are within the report interval.
+    assert hh_hi == 192, hh_hi
+    assert hh_lo == 64, hh_lo
+    # Dense rectangles identical (exact in both modes).
+    assert (
+        np.asarray(state_hi.pod_forward) == np.asarray(state_lo.pod_forward)
+    ).all()
+    assert int(np.asarray(state_lo.totals)[0]) == 192
+
+    # Config validation: low without conntrack is rejected.
+    with pytest.raises(ValueError):
+        _dc.replace(base, enable_conntrack=False,
+                    data_aggregation_level="low")
+
+
+def test_ct_totals_accounting():
+    """ct_totals accumulates reported packets/bytes (two-limb u32)."""
+    from retina_tpu.models.identity import IdentityMap
+
+    cfg = PipelineConfig(
+        n_pods=64, cms_width=1 << 10, topk_slots=1 << 6,
+        conntrack_slots=1 << 10, latency_slots=1 << 6,
+        entropy_buckets=1 << 8, hll_precision=8,
+    )
+    ident = IdentityMap.build_host({POD_NET + 1: 1}, n_slots=1 << 8)
+    pipe = TelemetryPipeline(cfg)
+    step = pipe.jitted_step()
+    state = pipe.init_state()
+    rec = mk_records(10, src_pods=np.full(10, 1), dst_pods=np.full(10, 2),
+                     bytes_=100)
+    # Batch 1: new connection reports immediately, carrying 10 pkts/1000B.
+    state, _ = step(state, jnp.asarray(rec), jnp.uint32(10), jnp.uint32(5),
+                    ident, jnp.uint32(0))
+    ctt = np.asarray(state.ct_totals)
+    assert ctt[0] == 10 and ctt[2] == 1000, ctt
+    # Batch 2 within the interval: no report, totals unchanged.
+    state, _ = step(state, jnp.asarray(rec), jnp.uint32(10), jnp.uint32(6),
+                    ident, jnp.uint32(0))
+    ctt = np.asarray(state.ct_totals)
+    assert ctt[0] == 10 and ctt[2] == 1000, ctt
+
+
+def test_sum64_exact_over_u32_wrap():
+    from retina_tpu.models.pipeline import _sum64
+
+    # Two ~3 GiB report values: plain u32 sum wraps; _sum64 must not.
+    x = jnp.asarray(np.array([3_000_000_000, 3_000_000_000, 7, 0], np.uint64)
+                    .astype(np.uint32))
+    lo, hi = _sum64(x)
+    total = int(lo) + (int(hi) << 32)
+    assert total == 6_000_000_007, total
+    # Random fuzz vs python bigint.
+    rng = np.random.default_rng(1)
+    for _ in range(3):
+        v = rng.integers(0, 2**32, 4096, dtype=np.uint64).astype(np.uint32)
+        lo, hi = _sum64(jnp.asarray(v))
+        assert int(lo) + (int(hi) << 32) == int(v.astype(object).sum())
+
+
+def test_preaggregated_packets_column_consistent_low_high():
+    """A record with PACKETS=N contributes N in BOTH aggregation modes
+    (conntrack accumulates the packets column, not row counts)."""
+    import dataclasses as _dc
+
+    cfg = PipelineConfig(
+        n_pods=64, cms_width=1 << 10, topk_slots=1 << 6,
+        conntrack_slots=1 << 10, latency_slots=1 << 6,
+        entropy_buckets=1 << 8, hll_precision=8,
+    )
+    ident = IdentityMap.build_host({POD_NET + 1: 1}, n_slots=1 << 8)
+    rec = mk_records(8, src_pods=np.full(8, 1), dst_pods=np.full(8, 2))
+    rec[:, F.PACKETS] = 50  # pre-aggregated 50 packets per record
+
+    def hh(level):
+        pipe = TelemetryPipeline(
+            _dc.replace(cfg, data_aggregation_level=level)
+        )
+        state = pipe.init_state()
+        state, _ = pipe.jitted_step()(
+            state, jnp.asarray(rec), jnp.uint32(8), jnp.uint32(5),
+            ident, jnp.uint32(0),
+        )
+        _, counts = state.flow_hh.table.top_k_host(2)
+        return int(counts[0])
+
+    assert hh("high") == 400
+    assert hh("low") == 400  # new conn -> immediate report carrying 400
