@@ -48,6 +48,16 @@ class Config:
     enable_hubble: bool = False  # flow-relay control plane (cmd/hubble)
     hubble_addr: str = "127.0.0.1:4244"
     hubble_ring_capacity: int = 1 << 12
+    # Dedicated hubble metrics mux (reference :9965); "" disables.
+    hubble_metrics_addr: str = ""
+    # TLS for the flow relay (reference hubble TLS options). PEM paths;
+    # client CA set => mutual TLS required.
+    hubble_tls_cert: str = ""
+    hubble_tls_key: str = ""
+    hubble_tls_client_ca: str = ""
+    # Static peer list for the peer service: [{"name", "address"}].
+    hubble_peers: list = dataclasses.field(default_factory=list)
+    node_name: str = ""
     log_level: str = "info"
     log_file: str = ""  # empty = stderr only
 

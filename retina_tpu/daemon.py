@@ -54,7 +54,27 @@ class Daemon:
             )
             self.monitoragent.register_consumer(self.observer.consume)
             self.cm.pluginmanager.setup_channel(self.monitoragent.channel)
-            self.hubble = HubbleServer(self.observer, addr=cfg.hubble_addr)
+            self.hubble = HubbleServer(
+                self.observer,
+                addr=cfg.hubble_addr,
+                peers=list(cfg.hubble_peers),
+                node_name=cfg.node_name,
+                tls_cert=cfg.hubble_tls_cert,
+                tls_key=cfg.hubble_tls_key,
+                tls_client_ca=cfg.hubble_tls_client_ca,
+            )
+            self.hubble_metrics_server = None
+            if cfg.hubble_metrics_addr:
+                # Dedicated hubble metrics mux (:9965 analog): serves ONLY
+                # the hubble registry so scraping both muxes never
+                # double-ingests the node/pod families.
+                from retina_tpu.exporter import get_exporter
+                from retina_tpu.server import Server
+
+                self.hubble_metrics_server = Server(
+                    cfg.hubble_metrics_addr,
+                    gather=get_exporter().gather_hubble_text,
+                )
         if cfg.enable_pod_level:
             dns_plugin = self.cm.pluginmanager.plugins.get("dns")
             self.metrics_module = MetricsModule(
@@ -77,6 +97,8 @@ class Daemon:
             self.monitoragent.start(stop)
         if self.hubble is not None:
             self.hubble.start()
+            if getattr(self, "hubble_metrics_server", None) is not None:
+                self.hubble_metrics_server.start()
         if self.metrics_module is not None:
             self.metrics_module.reconcile(MetricsConfiguration.default())
             self._mm_thread = threading.Thread(
@@ -107,6 +129,8 @@ class Daemon:
         finally:
             if self.hubble is not None:
                 self.hubble.stop()
+                if getattr(self, "hubble_metrics_server", None) is not None:
+                    self.hubble_metrics_server.stop()
 
 
 def run_agent(

@@ -30,6 +30,10 @@ class Exporter:
     def __init__(self) -> None:
         self.default_registry = CollectorRegistry()
         self.advanced_registry = CollectorRegistry()
+        # Hubble self-metrics live in their OWN registry, served by the
+        # dedicated hubble metrics mux (reference :9965) and NOT by the
+        # combined gatherer — scraping both muxes must not double-ingest.
+        self.hubble_registry = CollectorRegistry()
         self._reset_cbs: list[Callable[[], None]] = []
         self._lock = threading.Lock()
 
@@ -74,6 +78,22 @@ class Exporter:
         return Histogram(
             name, help_ or name, labels,
             buckets=buckets, registry=self.default_registry,
+        )
+
+    def gather_hubble_text(self) -> bytes:
+        """Exposition of the hubble registry only (:9965 mux)."""
+        return generate_latest(self.hubble_registry)
+
+    def new_hubble_gauge(self, name: str, labels: list[str],
+                         help_: str = "") -> Gauge:
+        return Gauge(
+            name, help_ or name, labels, registry=self.hubble_registry
+        )
+
+    def new_hubble_counter(self, name: str, labels: list[str],
+                           help_: str = "") -> Counter:
+        return Counter(
+            name, help_ or name, labels, registry=self.hubble_registry
         )
 
     def new_adv_gauge(self, name: str, labels: list[str], help_: str = "") -> Gauge:

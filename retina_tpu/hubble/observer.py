@@ -30,6 +30,10 @@ class FlowObserver:
         self.cache = cache
         self.dns_resolver = dns_resolver
         self.flows_seen = 0
+        # Ring entries skipped by lagging readers, summed across readers
+        # (per-reader loss is ALSO surfaced in-stream as LostEvent
+        # markers; this aggregate only feeds the self-metric gauge).
+        self.lost_observed = 0
 
     # -- writer side (monitoragent consumer) ---------------------------
     def consume(self, records: np.ndarray) -> None:
@@ -45,6 +49,49 @@ class FlowObserver:
             self._lock.notify_all()
 
     # -- reader side ---------------------------------------------------
+    def snapshot_flows(self) -> tuple[list[dict], int]:
+        """All currently-buffered flows (oldest first) + the sequence
+        cursor to continue from with :meth:`follow_from`. Servers filter
+        this list THEN apply last-N windowing, matching upstream Hubble's
+        'N most recent matching flows' semantics."""
+        with self._lock:
+            end = self._seq
+            window = min(end, self._cap)
+            flows = [
+                self._ring[i & (self._cap - 1)]
+                for i in range(end - window, end)
+            ]
+        return [f for f in flows if f is not None], end
+
+    def follow_from(
+        self,
+        cursor: int,
+        stop: Optional[threading.Event] = None,
+    ) -> Iterator[tuple[str, Any]]:
+        """Follow the ring from ``cursor``: yields ("flow", flow) items
+        and ("lost", n) markers when this reader fell behind (the
+        upstream in-stream LostEvent contract)."""
+        while stop is None or not stop.is_set():
+            batch: list = []
+            lost = 0
+            with self._lock:
+                floor = self._seq - self._cap
+                if cursor < floor:
+                    lost = floor - cursor
+                    self.lost_observed += lost
+                    cursor = floor
+                while cursor < self._seq:
+                    f = self._ring[cursor & (self._cap - 1)]
+                    cursor += 1
+                    if f is not None:
+                        batch.append(f)
+                if not batch and not lost:
+                    self._lock.wait(timeout=0.2)
+            if lost:
+                yield ("lost", lost)
+            for f in batch:
+                yield ("flow", f)
+
     def get_flows(
         self,
         filter: Optional[FlowFilter] = None,
@@ -63,7 +110,11 @@ class FlowObserver:
         while True:
             with self._lock:
                 if cursor < self._seq - self._cap:
-                    cursor = self._seq - self._cap  # fell behind: skip
+                    # Fell behind: skip (loss over blocking) and account
+                    # it (the reference's LostEvent with source
+                    # HUBBLE_RING_BUFFER).
+                    self.lost_observed += (self._seq - self._cap) - cursor
+                    cursor = self._seq - self._cap
                 limit = self._seq if follow else end0
                 batch = []
                 while cursor < limit:

@@ -1,18 +1,25 @@
 """Hubble gRPC flow relay: Observer + Peer services.
 
-Reference analog: pkg/hubble/hubble_linux.go — the Retina-flavored Hubble
-server exposing the flow gRPC API on :4244 (relay) and a peer service for
-node discovery, plus hubble_* self metrics. Services here are registered
-via gRPC generic handlers with msgpack frames (the image lacks
-protoc-gen-grpc; the transport is still gRPC/HTTP2 server-streaming, so a
-relay client's connection semantics are preserved).
+Reference analog: pkg/hubble/hubble_linux.go:52-99 — the Retina-flavored
+Hubble server exposing the flow gRPC API on :4244 (relay), a peer service
+for node discovery, TLS options, and hubble_* self metrics on :9965.
 
-API (service retina.Observer):
-- GetFlows(request) → stream of flow dicts; request: {"filter": {...},
-  "last": N, "follow": bool}
-- ServerStatus({}) → {"num_flows", "max_flows", "seen_flows", "uptime_ns"}
-service retina.Peer:
-- ListPeers({}) → {"peers": [{"name", "address"}]}
+TWO wire surfaces share the port:
+- **Cilium-compatible protobuf** (hubble/proto.py): services
+  ``observer.Observer`` (GetFlows streaming, ServerStatus) and
+  ``peer.Peer`` (Notify streaming) with upstream message/field numbering
+  — a stock Hubble relay/CLI client speaks this.
+- **legacy msgpack** (service ``retina.Observer``/``retina.Peer``) kept
+  for the in-tree lightweight client below.
+
+TLS: pass ``tls_cert``/``tls_key`` (PEM paths) to serve with
+``grpc.ssl_server_credentials`` (+ optional ``tls_client_ca`` for mTLS) —
+the reference's hubble TLS options.
+
+Self-metrics: ``hubble_flows_processed_total``, ``hubble_seen_flows``,
+``hubble_lost_events_total``, ``hubble_get_flows_requests_total`` in the
+default registry; the daemon additionally serves a dedicated metrics mux
+(:9965 analog) when ``hubble_metrics_addr`` is configured.
 """
 
 from __future__ import annotations
@@ -43,21 +50,92 @@ class HubbleServer:
         addr: str = "127.0.0.1:4244",
         peers: Optional[list[dict[str, str]]] = None,
         max_workers: int = 8,
+        node_name: str = "",
+        tls_cert: str = "",
+        tls_key: str = "",
+        tls_client_ca: str = "",
     ):
         self._log = logger("hubble")
         self.observer = observer
         self.addr = addr
         self.peers = peers or []
+        self.node_name = node_name
         self._t0 = time.time_ns()
         self._stop = threading.Event()
+        self._init_self_metrics()
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers)
         )
-        self._server.add_generic_rpc_handlers([self._make_handlers()])
-        self.port = self._server.add_insecure_port(addr)
+        self._server.add_generic_rpc_handlers(
+            [self._make_handlers(), self._make_pb_handlers()]
+        )
+        if tls_cert and tls_key:
+            with open(tls_key, "rb") as f:
+                key = f.read()
+            with open(tls_cert, "rb") as f:
+                cert = f.read()
+            root = None
+            require_client = False
+            if tls_client_ca:
+                with open(tls_client_ca, "rb") as f:
+                    root = f.read()
+                require_client = True
+            creds = grpc.ssl_server_credentials(
+                [(key, cert)], root_certificates=root,
+                require_client_auth=require_client,
+            )
+            self.port = self._server.add_secure_port(addr, creds)
+            self.tls = True
+        else:
+            self.port = self._server.add_insecure_port(addr)
+            self.tls = False
+
+    def _init_self_metrics(self) -> None:
+        """hubble_* families in the DEDICATED hubble registry (served by
+        the :9965-analog mux, not the combined gatherer). Created once per
+        exporter and cached on it: re-constructing the server (agent
+        restart in-process, sequential e2e boots) must not raise
+        Duplicated timeseries."""
+        from retina_tpu.exporter import get_exporter
+
+        exp = get_exporter()
+        fams = getattr(exp, "_hubble_families", None)
+        if fams is None:
+            fams = {
+                "seen": exp.new_hubble_gauge(
+                    "hubble_seen_flows", [],
+                    "flows ever written to the ring",
+                ),
+                "lost": exp.new_hubble_gauge(
+                    "hubble_lost_events_total", ["source"],
+                    "ring entries skipped by lagging readers "
+                    "(summed across readers)",
+                ),
+                "requests": exp.new_hubble_counter(
+                    "hubble_get_flows_requests_total", ["surface"],
+                    "GetFlows calls served",
+                ),
+                "served": exp.new_hubble_counter(
+                    "hubble_flows_processed_total",
+                    ["type", "subtype", "verdict"],
+                    "flows served to clients",
+                ),
+            }
+            exp._hubble_families = fams
+        self.m_seen = fams["seen"]
+        self.m_lost = fams["lost"]
+        self.m_requests = fams["requests"]
+        self.m_served = fams["served"]
+        # Scrape-time evaluation: gauges read the live observer, so the
+        # mux reports fresh values without any RPC having to run first.
+        self.m_seen.set_function(lambda: self.observer.flows_seen)
+        self.m_lost.labels(source="HUBBLE_RING_BUFFER").set_function(
+            lambda: self.observer.lost_observed
+        )
 
     # -- service implementation ---------------------------------------
     def _get_flows(self, request: bytes, ctx) -> Iterator[bytes]:
+        self.m_requests.labels(surface="msgpack").inc()
         req = _unpack(request) if request else {}
         filt = (
             FlowFilter.from_dict(req["filter"]) if req.get("filter") else None
@@ -116,6 +194,128 @@ class HubbleServer:
                     self._list_peers,
                     request_deserializer=bypass,
                     response_serializer=bypass,
+                ),
+            },
+        )
+
+        class Multi(grpc.GenericRpcHandler):
+            def service(self, details):
+                return observer.service(details) or peer.service(details)
+
+        return Multi()
+
+    # -- Cilium-compatible protobuf surface ---------------------------
+    def _pb_get_flows(self, request, ctx) -> Iterator[Any]:
+        from retina_tpu.hubble import proto as pb
+
+        self.m_requests.labels(surface="protobuf").inc()
+        stop = threading.Event()
+        ctx.add_callback(stop.set)
+        whitelist = list(request.whitelist)
+        blacklist = list(request.blacklist)
+        last = int(request.number)
+
+        def passes(msg) -> bool:
+            if not pb.proto_filter_matches(whitelist, msg):
+                return False
+            if blacklist and pb.proto_filter_matches(blacklist, msg):
+                return False
+            return True
+
+        def to_resp(flow, msg):
+            self.m_served.labels(
+                type="L3_L4",
+                subtype=flow.get("event_type", "flow"),
+                verdict=flow.get("verdict", "VERDICT_UNKNOWN"),
+            ).inc()
+            resp = pb.GetFlowsResponse()
+            resp.flow.CopyFrom(msg)
+            resp.node_name = self.node_name
+            resp.time.CopyFrom(msg.time)
+            return resp
+
+        # Filter the buffered window FIRST, then apply last-N — upstream
+        # Hubble returns the N most recent MATCHING flows, not matches
+        # within the N most recent raw entries.
+        buffered, cursor = self.observer.snapshot_flows()
+        matching = []
+        for flow in buffered:
+            msg = pb.flow_dict_to_proto(flow, node_name=self.node_name)
+            if passes(msg):
+                matching.append((flow, msg))
+        if last:
+            matching = matching[-last:]
+        for flow, msg in matching:
+            if stop.is_set():
+                return
+            yield to_resp(flow, msg)
+
+        if not request.follow:
+            return
+        for kind, payload in self.observer.follow_from(cursor, stop):
+            if stop.is_set():
+                return
+            if kind == "lost":
+                resp = pb.GetFlowsResponse()
+                resp.lost_events.source = 3  # HUBBLE_RING_BUFFER
+                resp.lost_events.num_events_lost = int(payload)
+                yield resp
+                continue
+            msg = pb.flow_dict_to_proto(payload, node_name=self.node_name)
+            if passes(msg):
+                yield to_resp(payload, msg)
+
+    def _pb_server_status(self, request, ctx):
+        from retina_tpu.hubble import proto as pb
+
+        return pb.ServerStatusResponse(
+            num_flows=min(self.observer.flows_seen, self.observer._cap),
+            max_flows=self.observer._cap,
+            seen_flows=self.observer.flows_seen,
+            uptime_ns=time.time_ns() - self._t0,
+            version="retina-tpu",
+        )
+
+    def _pb_notify(self, request, ctx) -> Iterator[Any]:
+        """peer.Peer/Notify: stream the current peer set as PEER_ADDED
+        notifications, then keep the stream open for changes (static set
+        here completes the initial sync and waits)."""
+        from retina_tpu.hubble import proto as pb
+
+        stop = threading.Event()
+        ctx.add_callback(stop.set)
+        for p in self.peers:
+            yield pb.ChangeNotification(
+                name=p.get("name", ""), address=p.get("address", ""),
+                type=1,  # PEER_ADDED
+            )
+        stop.wait()
+
+    def _make_pb_handlers(self):
+        from retina_tpu.hubble import proto as pb
+
+        observer = grpc.method_handlers_generic_handler(
+            pb.OBSERVER_SERVICE_PB,
+            {
+                "GetFlows": grpc.unary_stream_rpc_method_handler(
+                    self._pb_get_flows,
+                    request_deserializer=pb.GetFlowsRequest.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
+                ),
+                "ServerStatus": grpc.unary_unary_rpc_method_handler(
+                    self._pb_server_status,
+                    request_deserializer=pb.ServerStatusRequest.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
+                ),
+            },
+        )
+        peer = grpc.method_handlers_generic_handler(
+            pb.PEER_SERVICE_PB,
+            {
+                "Notify": grpc.unary_stream_rpc_method_handler(
+                    self._pb_notify,
+                    request_deserializer=pb.NotifyRequest.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
                 ),
             },
         )

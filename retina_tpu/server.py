@@ -39,10 +39,12 @@ class Server:
         exporter: Optional[Exporter] = None,
         ready_check: Optional[Callable[[], bool]] = None,
         healthy_check: Optional[Callable[[], bool]] = None,
+        gather: Optional[Callable[[], bytes]] = None,
     ) -> None:
         host, _, port = addr.rpartition(":")
         self._host, self._port = host or "127.0.0.1", int(port)
         self._exporter = exporter or get_exporter()
+        self._gather = gather or self._exporter.gather_text
         self._ready = ready_check or (lambda: True)
         self._healthy = healthy_check or (lambda: True)
         self._vars: dict[str, Callable[[], object]] = {}
@@ -80,7 +82,7 @@ class Server:
                     if route == "/metrics":
                         self._send(
                             200,
-                            srv._exporter.gather_text(),
+                            srv._gather(),
                             "text/plain; version=0.0.4; charset=utf-8",
                         )
                     elif route == "/healthz":
