@@ -260,6 +260,32 @@ def cmd_shell(args: argparse.Namespace) -> int:
     os.execvp(shell, [shell])
 
 
+def cmd_relay(args: argparse.Namespace) -> int:
+    """Run the cluster-wide flow relay (the hubble-relay binary analog):
+    fans in peer agents' GetFlows streams, serves one Observer surface."""
+    import signal
+    import threading
+
+    from retina_tpu.hubble.relay import HubbleRelay
+
+    peers = [
+        {"name": p, "address": p} for p in (args.peer or [])
+    ]
+    relay = HubbleRelay(
+        peers=peers,
+        discover_from=args.discover_from,
+        addr=args.addr,
+        node_name=args.name,
+    )
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    relay.start()
+    stop.wait()
+    relay.stop()
+    return 0
+
+
 def cmd_version(args: argparse.Namespace) -> int:
     print(f"{buildinfo.APP_NAME} {buildinfo.VERSION}")
     return 0
@@ -333,6 +359,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     sh = sub.add_parser("shell", help="network debug shell")
     sh.set_defaults(fn=cmd_shell)
+
+    rl = sub.add_parser("relay", help="cluster-wide flow relay")
+    rl.add_argument("--peer", action="append", metavar="HOST:PORT",
+                    help="agent relay endpoint (repeatable)")
+    rl.add_argument("--discover-from", default="",
+                    metavar="HOST:PORT",
+                    help="seed agent whose peer service lists the cluster")
+    rl.add_argument("--addr", default="127.0.0.1:4245")
+    rl.add_argument("--name", default="relay")
+    rl.set_defaults(fn=cmd_relay)
 
     v = sub.add_parser("version")
     v.set_defaults(fn=cmd_version)

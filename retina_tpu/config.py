@@ -58,6 +58,13 @@ class Config:
     # Static peer list for the peer service: [{"name", "address"}].
     hubble_peers: list = dataclasses.field(default_factory=list)
     node_name: str = ""
+
+    # --- multi-host distributed runtime (jax.distributed over DCN;
+    # SURVEY.md §5.8: cross-slice merges ride the distributed runtime
+    # while intra-slice psum rides ICI). "" = single-process. ---
+    distributed_coordinator: str = ""  # "host:port" of process 0
+    distributed_num_processes: int = 1
+    distributed_process_id: int = 0
     log_level: str = "info"
     log_file: str = ""  # empty = stderr only
 

@@ -131,6 +131,10 @@ class Cache:
         with self._lock:
             self._nodes[node.name] = node
 
+    def list_nodes(self) -> list[RetinaNode]:
+        with self._lock:
+            return list(self._nodes.values())
+
     # -- getters (cache.go:68-195) ------------------------------------
     def get_obj_by_ip(self, ip: str):
         with self._lock:

@@ -54,10 +54,28 @@ class Daemon:
             )
             self.monitoragent.register_consumer(self.observer.consume)
             self.cm.pluginmanager.setup_channel(self.monitoragent.channel)
+            # Peer set = static config peers + the node store (nodes the
+            # operator publishes land in the cache; the peer service then
+            # reflects live cluster membership, not boot-time config).
+            def _peers() -> list[dict[str, str]]:
+                # Peers serve on the same configured hubble port; with an
+                # ephemeral bind (tests) fall back to our bound port.
+                port = cfg.hubble_addr.rsplit(":", 1)[1]
+                if port == "0" and self.hubble is not None:
+                    port = str(self.hubble.port)
+                out = [dict(p) for p in cfg.hubble_peers]
+                seen = {p.get("address") for p in out}
+                for n in self.cm.cache.list_nodes():
+                    if n.ip and n.name != cfg.node_name:
+                        addr = f"{n.ip}:{port}"
+                        if addr not in seen:
+                            out.append({"name": n.name, "address": addr})
+                return out
+
             self.hubble = HubbleServer(
                 self.observer,
                 addr=cfg.hubble_addr,
-                peers=list(cfg.hubble_peers),
+                peers=_peers,
                 node_name=cfg.node_name,
                 tls_cert=cfg.hubble_tls_cert,
                 tls_key=cfg.hubble_tls_key,
@@ -142,6 +160,18 @@ def run_agent(
     """Build + run the agent (blocking). SIGTERM/SIGINT → clean stop."""
     cfg = load_config(config_path, overrides=overrides)
     setup_logger(cfg.log_level, cfg.log_file)
+    if cfg.distributed_coordinator:
+        # Multi-host mesh: must run before any backend use so every
+        # process sees the global device set (jax.devices() spans hosts;
+        # shard_map collectives then ride ICI within a slice and DCN
+        # across hosts — no hand-written NCCL/MPI analog).
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=cfg.distributed_coordinator,
+            num_processes=cfg.distributed_num_processes,
+            process_id=cfg.distributed_process_id,
+        )
     stop = threading.Event()
     if install_signals:
         for sig in (signal.SIGTERM, signal.SIGINT):

@@ -37,10 +37,13 @@ class FlowObserver:
 
     # -- writer side (monitoragent consumer) ---------------------------
     def consume(self, records: np.ndarray) -> None:
-        flows = [
+        self.consume_flows([
             record_to_flow(rec, self.cache, self.dns_resolver)
             for rec in records
-        ]
+        ])
+
+    def consume_flows(self, flows: list[dict]) -> None:
+        """Write already-decoded flow dicts (relay peer ingestion)."""
         with self._lock:
             for f in flows:
                 self._ring[self._seq & (self._cap - 1)] = f

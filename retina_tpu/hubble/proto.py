@@ -337,7 +337,9 @@ def flow_dict_to_proto(f: dict[str, Any], node_name: str = "") -> Any:
         msg.l4.UDP.source_port = int(l4.get("source_port", 0))
         msg.l4.UDP.destination_port = int(l4.get("destination_port", 0))
     msg.Type = 1  # L3_L4
-    msg.node_name = node_name
+    # Relay-ingested flows carry their ORIGIN node; only flows born on
+    # this node get stamped with the local name.
+    msg.node_name = f.get("node_name") or node_name
     if f.get("drop_reason") is not None:
         msg.drop_reason = int(f["drop_reason"])
         msg.drop_reason_desc = int(f["drop_reason"])
@@ -359,10 +361,70 @@ def flow_dict_to_proto(f: dict[str, Any], node_name: str = "") -> Any:
         msg.l7.dns.rcode = int(dns.get("rcode", 0))
         qt = dns.get("qtype")
         if qt is not None:
-            msg.l7.dns.qtypes.append(_QTYPE_NAMES.get(int(qt), str(qt)))
+            # Numeric qtype from the decoder; already-named qtype when a
+            # relay round-trips a flow it ingested from a peer.
+            if isinstance(qt, int) or str(qt).isdigit():
+                msg.l7.dns.qtypes.append(_QTYPE_NAMES.get(int(qt), str(qt)))
+            else:
+                msg.l7.dns.qtypes.append(str(qt))
     msg.is_reply.value = bool(f.get("is_reply", False))
     msg.reply = bool(f.get("is_reply", False))
     return msg
+
+
+_VERDICT_NAME = {v: k for k, v in _VERDICT_NUM.items()}
+_DIR_NAME = {v: k for k, v in _DIR_NUM.items()}
+
+
+def flow_proto_to_dict(msg: Any) -> dict[str, Any]:
+    """flow.Flow → internal flow dict (inverse of flow_dict_to_proto);
+    the relay stores peer flows in its local FlowObserver ring this way.
+    """
+    f: dict[str, Any] = {
+        "time_ns": msg.time.seconds * 1_000_000_000 + msg.time.nanos,
+        "verdict": _VERDICT_NAME.get(msg.verdict, "VERDICT_UNKNOWN"),
+        "traffic_direction": _DIR_NAME.get(
+            msg.traffic_direction, "TRAFFIC_DIRECTION_UNKNOWN"
+        ),
+        "ip": {"source": msg.IP.source, "destination": msg.IP.destination},
+        "node_name": msg.node_name,
+        "is_reply": msg.is_reply.value,
+    }
+    which = msg.l4.WhichOneof("protocol")
+    if which:
+        l4msg = getattr(msg.l4, which)
+        l4: dict[str, Any] = {
+            "protocol": which,
+            "source_port": l4msg.source_port,
+            "destination_port": l4msg.destination_port,
+        }
+        if which == "TCP":
+            l4["flags"] = [
+                n for n in ("FIN", "SYN", "RST", "PSH", "ACK", "URG",
+                            "ECE", "CWR")
+                if getattr(l4msg.flags, n)
+            ]
+        f["l4"] = l4
+    if msg.verdict == 2:
+        f["drop_reason"] = msg.drop_reason
+    for side, field in (("source", msg.source),
+                        ("destination", msg.destination)):
+        if field.pod_name or field.namespace:
+            f[side] = {
+                "namespace": field.namespace,
+                "pod_name": field.pod_name,
+                "labels": list(field.labels),
+                "workloads": [w.name for w in field.workloads],
+            }
+    if msg.l7.WhichOneof("record") == "dns":
+        f["l7_dns"] = {
+            "query": msg.l7.dns.query,
+            "rcode": msg.l7.dns.rcode,
+            "qtype": list(msg.l7.dns.qtypes)[0] if msg.l7.dns.qtypes else None,
+        }
+        f["event_type"] = ("dns_request" if msg.l7.type == 1
+                           else "dns_response")
+    return f
 
 
 def proto_filter_matches(filters: list, flow_msg: Any) -> bool:

@@ -58,7 +58,10 @@ class HubbleServer:
         self._log = logger("hubble")
         self.observer = observer
         self.addr = addr
-        self.peers = peers or []
+        # ``peers`` may be a static list or a zero-arg callable returning
+        # the CURRENT peer set (daemon wires the node store in, so peer
+        # listings track cluster membership instead of boot-time config).
+        self.peers = peers if peers is not None else []
         self.node_name = node_name
         self._t0 = time.time_ns()
         self._stop = threading.Event()
@@ -167,8 +170,11 @@ class HubbleServer:
             }
         )
 
+    def _peer_list(self) -> list[dict[str, str]]:
+        return list(self.peers()) if callable(self.peers) else list(self.peers)
+
     def _list_peers(self, request: bytes, ctx) -> bytes:
-        return _pack({"peers": self.peers})
+        return _pack({"peers": self._peer_list()})
 
     def _make_handlers(self):
         bypass = lambda x: x  # already-packed bytes
@@ -284,12 +290,20 @@ class HubbleServer:
 
         stop = threading.Event()
         ctx.add_callback(stop.set)
-        for p in self.peers:
-            yield pb.ChangeNotification(
-                name=p.get("name", ""), address=p.get("address", ""),
-                type=1,  # PEER_ADDED
-            )
-        stop.wait()
+        sent: set[str] = set()
+        while not stop.is_set():
+            for p in self._peer_list():
+                addr = p.get("address", "")
+                if addr and addr not in sent:
+                    sent.add(addr)
+                    yield pb.ChangeNotification(
+                        name=p.get("name", ""), address=addr,
+                        type=1,  # PEER_ADDED
+                    )
+            # Poll for membership changes (node store updates) while the
+            # stream is open — the reference peer service pushes changes
+            # the same way.
+            stop.wait(0.5)
 
     def _make_pb_handlers(self):
         from retina_tpu.hubble import proto as pb

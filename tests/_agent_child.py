@@ -1,0 +1,69 @@
+"""Child process for multi-node tests: boots a full agent with hubble
+enabled and synthetic traffic, prints the bound hubble port on stdout,
+runs until stdin closes (parent exit kills it)."""
+
+import sys
+
+sys.path.insert(0, sys.argv[1])  # repo root
+
+import os  # noqa: E402
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import threading  # noqa: E402
+import time  # noqa: E402
+
+from retina_tpu.common import RetinaEndpoint, RetinaNode  # noqa: E402
+from retina_tpu.config import Config  # noqa: E402
+from retina_tpu.daemon import Daemon  # noqa: E402
+
+
+def main() -> None:
+    node_name = sys.argv[2] if len(sys.argv) > 2 else "node-a"
+    cfg = Config()
+    cfg.api_server_addr = "127.0.0.1:0"
+    cfg.enabled_plugins = ["packetparser"]
+    cfg.event_source = "synthetic"
+    cfg.synthetic_rate = 20_000
+    cfg.synthetic_flows = 500
+    cfg.enable_hubble = True
+    cfg.hubble_addr = "127.0.0.1:0"
+    cfg.node_name = node_name
+    cfg.mesh_devices = 1
+    cfg.batch_capacity = 1 << 10
+    cfg.n_pods = 1 << 8
+    cfg.cms_width = 1 << 10
+    cfg.topk_slots = 1 << 7
+    cfg.hll_precision = 8
+    cfg.entropy_buckets = 1 << 8
+    cfg.conntrack_slots = 1 << 10
+    cfg.identity_slots = 1 << 10
+    cfg.bypass_lookup_ip_of_interest = True
+
+    d = Daemon(cfg)
+    d.cm.cache.update_endpoint(
+        RetinaEndpoint(name="pod-1", namespace="default", ips=("10.0.0.1",))
+    )
+    # Publish a (fake) additional cluster node so the parent can verify
+    # store-driven peer discovery through the peer service.
+    d.cm.cache.update_node(RetinaNode(name="node-x", ip="10.99.0.7"))
+    stop = threading.Event()
+    t = threading.Thread(target=d.start, args=(stop,), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if d.observer is not None and d.observer.flows_seen > 0:
+            break
+        time.sleep(0.1)
+    print(f"HUBBLE_PORT={d.hubble.port}", flush=True)
+    # Block until the parent closes our stdin.
+    sys.stdin.read()
+    stop.set()
+    t.join(5)
+
+
+if __name__ == "__main__":
+    main()
