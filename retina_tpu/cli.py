@@ -55,61 +55,61 @@ def cmd_agent(args: argparse.Namespace) -> int:
 
 # -------------------------------------------------------------- operator
 def cmd_operator(args: argparse.Namespace) -> int:
-    """Watch a directory of CRD YAMLs and reconcile (the operator main).
+    """Operator main: reconcilers against an external CR backend.
 
-    File naming: kind is read from each document's ``kind:`` field.
+    Backends (retina_tpu/operator/bridge.py): ``--watch-dir`` (directory
+    of CR YAMLs; status written back beside the files) or
+    ``--kubeconfig`` (kube-apiserver list+watch on the retina.sh CRs) —
+    the reference operator against controller-runtime informers
+    (pkg/controllers/operator/capture/controller.go:102).
     """
-    import yaml
+    import signal
+    import threading
 
-    from retina_tpu.crd.types import (
-        Capture,
-        MetricsConfiguration,
-        TracesConfiguration,
-    )
     from retina_tpu.log import setup_logger
     from retina_tpu.operator import CRDStore, Operator
 
     setup_logger()
+    if not args.watch_dir and not args.kubeconfig:
+        print("operator: need --watch-dir or --kubeconfig", file=sys.stderr)
+        return 2
     store = CRDStore()
-    op = Operator(store, node_name=args.node_name)
+    bridges = []
+    sinks = []
+    if args.watch_dir:
+        from retina_tpu.operator.bridge import FileBridge
+
+        fb = FileBridge(store, args.watch_dir,
+                        poll_interval=args.poll_interval)
+        bridges.append(fb)
+        sinks.append(fb.on_status)
+    if args.kubeconfig:
+        from retina_tpu.operator.bridge import KubeBridge
+
+        kube = KubeBridge(store, args.kubeconfig,
+                          namespace=args.namespace)
+        bridges.append(kube)
+        sinks.append(kube.patch_status)
+
+    def fan_out_status(kind, obj):
+        for s in sinks:
+            s(kind, obj)
+
+    op = Operator(
+        store, node_name=args.node_name,
+        status_sink=fan_out_status if sinks else None,
+    )
     op.start()
-    seen: dict[str, float] = {}
-    print(f"operator watching {args.watch_dir} (ctrl-c to stop)")
-    try:
-        while True:
-            for fname in sorted(os.listdir(args.watch_dir)):
-                if not fname.endswith((".yaml", ".yml")):
-                    continue
-                path = os.path.join(args.watch_dir, fname)
-                mtime = os.path.getmtime(path)
-                if seen.get(path) == mtime:
-                    continue
-                seen[path] = mtime
-                with open(path) as fh:
-                    doc = yaml.safe_load(fh) or {}
-                kind = doc.get("kind", "")
-                try:
-                    if kind == "Capture":
-                        store.apply("Capture", Capture.from_yaml(
-                            yaml.safe_dump(doc)))
-                    elif kind == "MetricsConfiguration":
-                        store.apply(
-                            "MetricsConfiguration",
-                            MetricsConfiguration.from_yaml(
-                                yaml.safe_dump(doc)),
-                        )
-                    elif kind == "TracesConfiguration":
-                        store.apply("TracesConfiguration",
-                                    TracesConfiguration(
-                                        name=doc.get("metadata", {}).get(
-                                            "name", "default")))
-                    else:
-                        print(f"skipping {fname}: unknown kind {kind!r}")
-                except Exception as e:
-                    print(f"error applying {fname}: {e}", file=sys.stderr)
-            time.sleep(args.poll_interval)
-    except KeyboardInterrupt:
-        return 0
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    for b in bridges:
+        b.start()
+    print("operator running (ctrl-c to stop)")
+    stop.wait()
+    for b in bridges:
+        b.stop()
+    return 0
 
 
 # -------------------------------------------------------------- capture
@@ -303,7 +303,12 @@ def build_parser() -> argparse.ArgumentParser:
     a.set_defaults(fn=cmd_agent)
 
     o = sub.add_parser("operator", help="run the operator")
-    o.add_argument("--watch-dir", required=True)
+    o.add_argument("--watch-dir", default="",
+                   help="directory of CR YAMLs (file backend)")
+    o.add_argument("--kubeconfig", default="",
+                   help="kubeconfig path (kube-apiserver backend)")
+    o.add_argument("--namespace", default="",
+                   help="namespace scope for --kubeconfig ('' = all)")
     o.add_argument("--node-name", default="local")
     o.add_argument("--poll-interval", type=float, default=2.0)
     o.set_defaults(fn=cmd_operator)

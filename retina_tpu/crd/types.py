@@ -278,6 +278,23 @@ class Capture:
                 if isinstance(s.get("tcpdumpFilter", ""), str) else "",
             ),
         )
+        # Preserve status if the document carries one: objects echoed back
+        # by a backend (apiserver watch after our own status PATCH, or a
+        # re-LIST of already-Completed captures) must NOT reset to Pending,
+        # or the operator would re-run finished captures forever.
+        st = doc.get("status") or {}
+        if st:
+            obj.status = CaptureStatus(
+                phase=st.get("phase", "Pending"),
+                jobs_active=int(st.get("jobs_active",
+                                       st.get("jobsActive", 0)) or 0),
+                jobs_completed=int(st.get("jobs_completed",
+                                          st.get("jobsCompleted", 0)) or 0),
+                jobs_failed=int(st.get("jobs_failed",
+                                       st.get("jobsFailed", 0)) or 0),
+                message=st.get("message", ""),
+                artifacts=list(st.get("artifacts", [])),
+            )
         obj.validate()
         return obj
 

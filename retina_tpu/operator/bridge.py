@@ -1,0 +1,361 @@
+"""External backends for the CRD store seam.
+
+Reference analog: the reference operator's reconcilers are fed by
+controller-runtime informers against a real kube-apiserver
+(pkg/controllers/operator/capture/controller.go:102; envtest in unit
+tests). The in-process :class:`CRDStore` is that seam here; this module
+plugs EXTERNAL sources into it so the same reconcilers run unmodified:
+
+- :class:`FileBridge` — watches a directory of CR YAMLs (the envtest/
+  fake-apiserver analog): apply on add/change, delete on file removal,
+  and Capture status written back next to the source file (the status-
+  subresource analog), so ``kubectl-retina``-style workflows complete
+  against plain files.
+- :class:`KubeBridge` — a minimal kube-apiserver client built on the
+  standard library (this image has no ``kubernetes`` package): reads a
+  kubeconfig (server + CA + token/client-cert), LISTs the retina.sh
+  custom resources, then WATCHes with resourceVersion resumption, and
+  PATCHes the status subresource on reconcile — the same REST contract
+  controller-runtime speaks.
+
+Both run a background thread, never raise out of it, and translate to the
+store's apply/delete informer events.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import os
+import ssl
+import tempfile
+import threading
+import urllib.request
+from typing import Any, Optional
+
+import yaml
+
+from retina_tpu.crd.types import (
+    Capture,
+    MetricsConfiguration,
+    TracesConfiguration,
+)
+from retina_tpu.log import logger
+from retina_tpu.operator.store import CRDStore
+
+GROUP = "retina.sh"
+VERSION = "v1alpha1"
+# kind -> (plural, parser)
+KINDS: dict[str, Any] = {
+    "Capture": ("captures", lambda doc: Capture.from_yaml(yaml.safe_dump(doc))),
+    "MetricsConfiguration": (
+        "metricsconfigurations",
+        lambda doc: MetricsConfiguration.from_yaml(yaml.safe_dump(doc)),
+    ),
+    "TracesConfiguration": (
+        "tracesconfigurations",
+        lambda doc: TracesConfiguration(
+            name=doc.get("metadata", {}).get("name", "default")
+        ),
+    ),
+}
+
+
+class FileBridge:
+    """Directory of CR YAMLs → CRDStore (apply/delete/status)."""
+
+    def __init__(self, store: CRDStore, directory: str,
+                 poll_interval: float = 0.5):
+        self._log = logger("filebridge")
+        self.store = store
+        self.directory = directory
+        self.poll_interval = poll_interval
+        self._seen: dict[str, float] = {}  # path -> mtime
+        self._applied: dict[str, list[tuple[str, str, str]]] = {}
+        #   path -> [(kind, namespace, name)] for every doc in the file
+        self._status_paths: dict[tuple[str, str, str], str] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sync_once(self) -> None:
+        """One reconcile pass: apply new/changed files, delete removed
+        files AND docs dropped from still-present multi-doc files."""
+        present: set[str] = set()
+        for fname in sorted(os.listdir(self.directory)):
+            if not fname.endswith((".yaml", ".yml")):
+                continue
+            path = os.path.join(self.directory, fname)
+            present.add(path)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            if self._seen.get(path) == mtime:
+                continue
+            self._seen[path] = mtime
+            try:
+                with open(path) as fh:
+                    docs = [d for d in yaml.safe_load_all(fh) if d]
+            except Exception as e:  # noqa: BLE001 — one bad file != down
+                self._log.warning("error reading %s: %s", path, e)
+                continue
+            n_caps = sum(1 for d in docs if d.get("kind") == "Capture")
+            entries: list[tuple[str, str, str]] = []
+            for doc in docs:
+                try:
+                    entry = self._apply_doc(path, doc, n_caps)
+                    if entry is not None:
+                        entries.append(entry)
+                except Exception as e:  # noqa: BLE001
+                    self._log.warning("error applying %s: %s", path, e)
+            for entry in self._applied.get(path, []):
+                if entry not in entries:
+                    self._delete_entry(entry)
+            self._applied[path] = entries
+        # Removal = deletion (the informer DELETE event).
+        for path in list(self._applied):
+            if path not in present:
+                for entry in self._applied.pop(path):
+                    self._delete_entry(entry)
+                self._seen.pop(path, None)
+
+    def _delete_entry(self, entry: tuple[str, str, str]) -> None:
+        kind, ns, name = entry
+        self._status_paths.pop(entry, None)
+        try:
+            self.store.delete(kind, name, ns)
+            self._log.info("deleted %s %s/%s (source doc removed)",
+                           kind, ns, name)
+        except KeyError:
+            pass
+
+    def _apply_doc(self, path: str, doc: dict,
+                   n_caps: int) -> Optional[tuple[str, str, str]]:
+        kind = doc.get("kind", "")
+        if kind not in KINDS:
+            self._log.warning("skipping %s: unknown kind %r", path, kind)
+            return None
+        obj = KINDS[kind][1](doc)
+        ns = getattr(obj, "namespace", "") or "default"
+        entry = (kind, ns, obj.name)
+        if kind == "Capture":
+            # Single-capture files keep the plain "<file>.status" contract;
+            # multi-capture files get per-name status files. Registered
+            # BEFORE apply: the store fires reconcilers synchronously and
+            # the Running status sync must find its path.
+            self._status_paths[entry] = (
+                path + ".status" if n_caps <= 1
+                else f"{path}.{obj.name}.status"
+            )
+        self.store.apply(kind, obj)
+        return entry
+
+    def on_status(self, kind: str, obj: Any) -> None:
+        """Status sink (wire as the Operator's ``status_sink``): write
+        the object's status beside its source file — the
+        status-subresource write-back analog."""
+        ns = getattr(obj, "namespace", "") or "default"
+        sp = self._status_paths.get((kind, ns, obj.name))
+        if sp is None:
+            return
+        tmp = sp + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(dataclasses.asdict(obj.status), fh, indent=2)
+        os.replace(tmp, sp)
+
+    def start(self) -> None:
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.sync_once()
+                except Exception:  # noqa: BLE001
+                    self._log.exception("file sync failed")
+                self._stop.wait(self.poll_interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="filebridge")
+        self._thread.start()
+        self._log.info("file bridge watching %s", self.directory)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+
+
+# ---------------------------------------------------------------------
+class KubeBridge:
+    """kube-apiserver → CRDStore via list+watch on the retina.sh CRs."""
+
+    def __init__(self, store: CRDStore, kubeconfig: str,
+                 namespace: str = "", retry_s: float = 2.0):
+        self._log = logger("kubebridge")
+        self.store = store
+        self.namespace = namespace
+        self.retry_s = retry_s
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._load_kubeconfig(kubeconfig)
+
+    # -- kubeconfig ----------------------------------------------------
+    def _load_kubeconfig(self, path: str) -> None:
+        with open(path) as fh:
+            kc = yaml.safe_load(fh) or {}
+        clusters = kc.get("clusters") or []
+        if not clusters:
+            raise ValueError(f"kubeconfig {path}: no clusters defined")
+        contexts = kc.get("contexts") or []
+        ctx_name = kc.get("current-context", "")
+        ctx = next(
+            (c.get("context", {}) for c in contexts
+             if c.get("name") == ctx_name),
+            contexts[0].get("context", {}) if contexts else {},
+        )
+        want_cluster = ctx.get("cluster", clusters[0].get("name"))
+        cluster = next(
+            (c["cluster"] for c in clusters
+             if c.get("name") == want_cluster), None,
+        )
+        if cluster is None:
+            raise ValueError(
+                f"kubeconfig {path}: context references unknown cluster "
+                f"{want_cluster!r}"
+            )
+        users = kc.get("users") or []
+        user = next(
+            (u.get("user", {}) for u in users
+             if u.get("name") == ctx.get("user")),
+            users[0].get("user", {}) if users else {},
+        )
+        if not cluster.get("server"):
+            raise ValueError(f"kubeconfig {path}: cluster has no server URL")
+        self.server = cluster["server"].rstrip("/")
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if self.server.startswith("https"):
+            self._ssl_ctx = ssl.create_default_context()
+            ca_data = cluster.get("certificate-authority-data")
+            ca_file = cluster.get("certificate-authority")
+            if ca_data:
+                self._ssl_ctx.load_verify_locations(
+                    cadata=base64.b64decode(ca_data).decode()
+                )
+            elif ca_file:
+                self._ssl_ctx.load_verify_locations(cafile=ca_file)
+            if cluster.get("insecure-skip-tls-verify"):
+                self._ssl_ctx.check_hostname = False
+                self._ssl_ctx.verify_mode = ssl.CERT_NONE
+            cert_data = user.get("client-certificate-data")
+            key_data = user.get("client-key-data")
+            if cert_data and key_data:
+                # load_cert_chain needs files; materialize with 0600.
+                fd, certpath = tempfile.mkstemp(suffix=".pem")
+                with os.fdopen(fd, "wb") as fh:
+                    fh.write(base64.b64decode(cert_data))
+                    fh.write(b"\n")
+                    fh.write(base64.b64decode(key_data))
+                self._ssl_ctx.load_cert_chain(certpath)
+                os.unlink(certpath)
+            elif user.get("client-certificate"):
+                self._ssl_ctx.load_cert_chain(
+                    user["client-certificate"], user.get("client-key")
+                )
+        self.token = user.get("token", "")
+
+    # -- REST ----------------------------------------------------------
+    def _url(self, plural: str, suffix: str = "", query: str = "") -> str:
+        ns = f"/namespaces/{self.namespace}" if self.namespace else ""
+        u = f"{self.server}/apis/{GROUP}/{VERSION}{ns}/{plural}{suffix}"
+        return u + (f"?{query}" if query else "")
+
+    def _request(self, url: str, method: str = "GET",
+                 body: bytes | None = None,
+                 content_type: str = "application/json"):
+        req = urllib.request.Request(url, data=body, method=method)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        return urllib.request.urlopen(req, context=self._ssl_ctx, timeout=300)
+
+    # -- list + watch --------------------------------------------------
+    def _ingest(self, kind: str, item: dict, event: str) -> None:
+        parse = KINDS[kind][1]
+        if event in ("ADDED", "MODIFIED"):
+            self.store.apply(kind, parse(item))
+        elif event == "DELETED":
+            meta = item.get("metadata", {})
+            try:
+                self.store.delete(
+                    kind, meta.get("name", ""),
+                    meta.get("namespace", "default"),
+                )
+            except KeyError:
+                pass
+
+    def _run_kind(self, kind: str, plural: str) -> None:
+        while not self._stop.is_set():
+            try:
+                with self._request(self._url(plural)) as resp:
+                    body = json.load(resp)
+                rv = body.get("metadata", {}).get("resourceVersion", "")
+                for item in body.get("items", []):
+                    self._ingest(kind, item, "ADDED")
+                # Watch from the list's resourceVersion; the apiserver
+                # streams one JSON object per line.
+                q = "watch=true" + (f"&resourceVersion={rv}" if rv else "")
+                with self._request(self._url(plural, query=q)) as stream:
+                    for line in stream:
+                        if self._stop.is_set():
+                            return
+                        if not line.strip():
+                            continue
+                        ev = json.loads(line)
+                        self._ingest(kind, ev.get("object", {}),
+                                     ev.get("type", ""))
+            except Exception as e:  # noqa: BLE001 — watch never dies
+                if self._stop.is_set():
+                    return
+                self._log.warning(
+                    "%s list/watch failed (%s: %s); retrying in %.1fs",
+                    plural, type(e).__name__, e, self.retry_s,
+                )
+            self._stop.wait(self.retry_s)
+
+    def patch_status(self, kind: str, obj: Any) -> None:
+        """PATCH the status subresource (merge-patch), best effort."""
+        plural = KINDS[kind][0]
+        ns = getattr(obj, "namespace", "") or "default"
+        if self.namespace:
+            url = self._url(plural, suffix=f"/{obj.name}/status")
+        else:
+            url = (
+                f"{self.server}/apis/{GROUP}/{VERSION}/namespaces/{ns}/"
+                f"{plural}/{obj.name}/status"
+            )
+        body = json.dumps(
+            {"status": dataclasses.asdict(obj.status)}
+        ).encode()
+        try:
+            self._request(url, method="PATCH", body=body,
+                          content_type="application/merge-patch+json").close()
+        except Exception as e:  # noqa: BLE001
+            self._log.warning("status patch %s/%s failed: %s",
+                              kind, obj.name, e)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        for kind, (plural, _) in KINDS.items():
+            t = threading.Thread(
+                target=self._run_kind, args=(kind, plural),
+                name=f"kubebridge-{plural}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        self._log.info("kube bridge watching %s at %s",
+                       ",".join(k for k, _ in KINDS.items()), self.server)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(2.0)

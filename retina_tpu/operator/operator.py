@@ -47,7 +47,12 @@ class Operator:
         node_name: str = "local",
         nodes: Optional[list[RetinaNode]] = None,
         capture_manager: Optional[CaptureManager] = None,
+        status_sink: Optional[Any] = None,
     ):
+        """``status_sink(kind, obj)`` is called when an object's status
+        settles — the kube backend passes KubeBridge.patch_status so
+        status reaches the apiserver's status subresource
+        (controller.go:142 updateCaptureStatusFromJobs analog)."""
         self._log = logger("operator")
         self.store = store
         self.cache = cache
@@ -56,8 +61,17 @@ class Operator:
         self.node_name = node_name
         self.nodes = nodes or [RetinaNode(name=node_name)]
         self.capture_manager = capture_manager or CaptureManager()
+        self.status_sink = status_sink
         self._jobs: dict[str, threading.Thread] = {}
         self._jobs_lock = threading.Lock()
+
+    def _sync_status(self, kind: str, obj: Any) -> None:
+        if self.status_sink is not None:
+            try:
+                self.status_sink(kind, obj)
+            except Exception:  # noqa: BLE001
+                self._log.exception("status sink failed for %s/%s",
+                                    kind, getattr(obj, "name", "?"))
 
     def start(self) -> None:
         """Register all watches (controller manager start analog)."""
@@ -71,6 +85,13 @@ class Operator:
     def _on_capture(self, event: str, cap: Capture) -> None:
         if event != "applied" or cap.status.phase not in ("Pending",):
             return
+        # Dedupe: a watch reconnect can re-LIST an in-flight capture whose
+        # apiserver copy still says Pending; don't start a duplicate job.
+        key = f"{cap.namespace}/{cap.name}"
+        with self._jobs_lock:
+            prev = self._jobs.get(key)
+            if prev is not None and prev.is_alive():
+                return
         try:
             pods = (
                 [ep for ep in self.cache.index_label_map().values()]
@@ -81,6 +102,7 @@ class Operator:
             cap.status.phase = "Failed"
             cap.status.message = str(e)
             self._log.warning("capture %s rejected: %s", cap.name, e)
+            self._sync_status(KIND_CAPTURE, cap)
             return
         local = [j for j in jobs if j.node_name in
                  {n.name for n in self.nodes}]
@@ -90,6 +112,9 @@ class Operator:
             "capture %s: %d job(s) (%d local)", cap.name, len(jobs),
             len(local),
         )
+        # Publish Running immediately so backends see the in-flight phase
+        # (and a watch echo of this write is a no-op, not a re-trigger).
+        self._sync_status(KIND_CAPTURE, cap)
 
         def run_all() -> None:
             failed = 0
@@ -106,17 +131,19 @@ class Operator:
                     cap.status.message = str(e)
                 cap.status.jobs_active -= 1
             cap.status.phase = "Failed" if failed else "Completed"
+            self._sync_status(KIND_CAPTURE, cap)
 
         t = threading.Thread(
             target=run_all, name=f"capture-{cap.name}", daemon=True
         )
         with self._jobs_lock:
-            self._jobs[cap.name] = t
+            self._jobs[key] = t
         t.start()
 
-    def wait_capture(self, name: str, timeout: float = 120.0) -> None:
+    def wait_capture(self, name: str, timeout: float = 120.0,
+                     namespace: str = "default") -> None:
         with self._jobs_lock:
-            t = self._jobs.get(name)
+            t = self._jobs.get(f"{namespace}/{name}")
         if t is not None:
             t.join(timeout)
 
