@@ -68,9 +68,16 @@ def run(smoke: bool) -> dict:
     import jax
     import jax.numpy as jnp
 
+    from retina_tpu.config import DEFAULT_CACHE_DIR, enable_compilation_cache
     from retina_tpu.events.synthetic import TrafficGen
     from retina_tpu.models.identity import IdentityMap
     from retina_tpu.models.pipeline import PipelineConfig, TelemetryPipeline
+
+    # Persistent XLA cache: a warm rerun skips the ~100 s full-shape
+    # compile, which is what an agent restart experiences in production.
+    # Same dir the daemon uses, so bench and agent warm one cache.
+    if enable_compilation_cache(DEFAULT_CACHE_DIR):
+        log(f"XLA compilation cache at {DEFAULT_CACHE_DIR}")
 
     out: dict = {
         "metric": "flow_events_per_sec_per_chip",

@@ -79,6 +79,12 @@ class Config:
 
     # --- TPU runtime knobs ---
     device_platform: str = ""  # "" = let JAX pick; "cpu" to force host
+    # Persistent XLA compilation cache: full-shape pipeline compile is
+    # ~100 s on TPU; caching it makes agent restarts (and the <1 s scrape
+    # SLA after restart) feasible. "" disables (default: opt in via the
+    # deploy configmap — DEFAULT_CACHE_DIR — so bare library/test use
+    # never touches global host state).
+    compilation_cache_dir: str = ""
     batch_capacity: int = 1 << 15  # events per device batch
     window_seconds: float = 1.0  # entropy/anomaly window
     flush_interval_s: float = 0.05  # max host-side batching latency
@@ -184,3 +190,35 @@ def load_config(
 
     cfg.validate()
     return cfg
+
+
+# Where the deploy manifests point compilation_cache_dir on a node.
+DEFAULT_CACHE_DIR = "/var/cache/retina-tpu/xla"
+
+
+def enable_compilation_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Returns True if enabled. Failure (unwritable dir, old jax) is
+    non-fatal but logged: the agent still boots, restarts just pay the
+    full compile again. JAX's default min-compile-time/size thresholds
+    are kept — the target is the ~100 s fused-step compile, and the
+    thresholds stop trivial compiles from growing the dir unboundedly.
+    """
+    if not cache_dir:
+        return False
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        return True
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        from retina_tpu.log import logger
+
+        logger("config").warning(
+            "compilation cache at %s unavailable (%s: %s); "
+            "restarts will pay full XLA compile",
+            cache_dir, type(e).__name__, e,
+        )
+        return False

@@ -18,7 +18,7 @@ import signal
 import threading
 from typing import Any, Optional
 
-from retina_tpu.config import Config, load_config
+from retina_tpu.config import Config, enable_compilation_cache, load_config
 from retina_tpu.crd.types import MetricsConfiguration
 from retina_tpu.log import logger, setup_logger
 from retina_tpu.managers.controllermanager import ControllerManager
@@ -29,6 +29,9 @@ class Daemon:
     def __init__(self, cfg: Config, apiserver_host: str = ""):
         self.cfg = cfg
         self.log = logger("daemon")
+        if enable_compilation_cache(cfg.compilation_cache_dir):
+            self.log.info("XLA compilation cache at %s",
+                          cfg.compilation_cache_dir)
         self.cm = ControllerManager(cfg, apiserver_host=apiserver_host)
         self.metrics_module: Optional[MetricsModule] = None
         self._mm_thread: Optional[threading.Thread] = None
