@@ -45,9 +45,12 @@ def _parse_overrides(pairs: list[str]) -> dict[str, Any]:
 def cmd_agent(args: argparse.Namespace) -> int:
     from retina_tpu.daemon import run_agent
 
+    overrides = _parse_overrides(args.set or [])
+    if getattr(args, "kubeconfig", ""):
+        overrides["kubeconfig"] = args.kubeconfig
     run_agent(
         config_path=args.config,
-        overrides=_parse_overrides(args.set or []),
+        overrides=overrides,
         apiserver_host=args.apiserver,
     )
     return 0
@@ -300,6 +303,8 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--config", default=None, help="YAML config path")
     a.add_argument("--set", action="append", metavar="KEY=VAL")
     a.add_argument("--apiserver", default="", help="apiserver host to watch")
+    a.add_argument("--kubeconfig", default="",
+                   help="watch core/v1 pods/services/nodes for identity")
     a.set_defaults(fn=cmd_agent)
 
     o = sub.add_parser("operator", help="run the operator")

@@ -58,6 +58,10 @@ class Config:
     # Static peer list for the peer service: [{"name", "address"}].
     hubble_peers: list = dataclasses.field(default_factory=list)
     node_name: str = ""
+    # Identity from a real cluster: core/v1 pods/services/nodes list+watch
+    # feeding the cache (pkg/k8s watcher analog). "" = in-process only.
+    kubeconfig: str = ""
+    kube_namespace: str = ""  # namespace scope for pod/service watches
 
     # --- multi-host distributed runtime (jax.distributed over DCN;
     # SURVEY.md §5.8: cross-slice merges ride the distributed runtime

@@ -135,6 +135,15 @@ class Cache:
         with self._lock:
             return list(self._nodes.values())
 
+    def list_endpoint_keys(self) -> list[str]:
+        """All ns/name endpoint keys (informer resync diff support)."""
+        with self._lock:
+            return list(self._eps.keys())
+
+    def list_service_keys(self) -> list[str]:
+        with self._lock:
+            return list(self._svcs.keys())
+
     # -- getters (cache.go:68-195) ------------------------------------
     def get_obj_by_ip(self, ip: str):
         with self._lock:

@@ -33,6 +33,21 @@ class Daemon:
             self.log.info("XLA compilation cache at %s",
                           cfg.compilation_cache_dir)
         self.cm = ControllerManager(cfg, apiserver_host=apiserver_host)
+        # Identity from a real cluster (pkg/k8s watcher analog): core/v1
+        # pods/services/nodes land in the same cache the CRD-store path
+        # feeds, so enrichment works without our operator running.
+        # Selected by an explicit kubeconfig OR automatically when running
+        # in-cluster with a service account (the daemonset deployment).
+        self.kubewatch = None
+        from retina_tpu.operator.kubeclient import in_cluster_available
+
+        if cfg.kubeconfig or in_cluster_available():
+            from retina_tpu.operator.kubewatch import CoreWatcher
+
+            self.kubewatch = CoreWatcher(
+                self.cm.cache, cfg.kubeconfig,
+                namespace=cfg.kube_namespace,
+            )
         self.metrics_module: Optional[MetricsModule] = None
         self._mm_thread: Optional[threading.Thread] = None
         self.hubble = None
@@ -145,9 +160,13 @@ class Daemon:
                         os.replace(path, path + ".bad")
                     except OSError:
                         pass
+        if self.kubewatch is not None:
+            self.kubewatch.start()
         try:
             self.cm.start(stop)  # blocks until stop fires; runs shutdown
         finally:
+            if self.kubewatch is not None:
+                self.kubewatch.stop()
             if self.hubble is not None:
                 self.hubble.stop()
                 if getattr(self, "hubble_metrics_server", None) is not None:

@@ -24,14 +24,10 @@ store's apply/delete informer events.
 
 from __future__ import annotations
 
-import base64
 import dataclasses
 import json
 import os
-import ssl
-import tempfile
 import threading
-import urllib.request
 from typing import Any, Optional
 
 import yaml
@@ -42,6 +38,7 @@ from retina_tpu.crd.types import (
     TracesConfiguration,
 )
 from retina_tpu.log import logger
+from retina_tpu.operator.kubeclient import KubeClient
 from retina_tpu.operator.store import CRDStore
 
 GROUP = "retina.sh"
@@ -188,6 +185,8 @@ class FileBridge:
 class KubeBridge:
     """kube-apiserver → CRDStore via list+watch on the retina.sh CRs."""
 
+    API_BASE = f"/apis/{GROUP}/{VERSION}"
+
     def __init__(self, store: CRDStore, kubeconfig: str,
                  namespace: str = "", retry_s: float = 2.0):
         self._log = logger("kubebridge")
@@ -196,90 +195,9 @@ class KubeBridge:
         self.retry_s = retry_s
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        self._load_kubeconfig(kubeconfig)
+        self.client = KubeClient(kubeconfig)
 
-    # -- kubeconfig ----------------------------------------------------
-    def _load_kubeconfig(self, path: str) -> None:
-        with open(path) as fh:
-            kc = yaml.safe_load(fh) or {}
-        clusters = kc.get("clusters") or []
-        if not clusters:
-            raise ValueError(f"kubeconfig {path}: no clusters defined")
-        contexts = kc.get("contexts") or []
-        ctx_name = kc.get("current-context", "")
-        ctx = next(
-            (c.get("context", {}) for c in contexts
-             if c.get("name") == ctx_name),
-            contexts[0].get("context", {}) if contexts else {},
-        )
-        want_cluster = ctx.get("cluster", clusters[0].get("name"))
-        cluster = next(
-            (c["cluster"] for c in clusters
-             if c.get("name") == want_cluster), None,
-        )
-        if cluster is None:
-            raise ValueError(
-                f"kubeconfig {path}: context references unknown cluster "
-                f"{want_cluster!r}"
-            )
-        users = kc.get("users") or []
-        user = next(
-            (u.get("user", {}) for u in users
-             if u.get("name") == ctx.get("user")),
-            users[0].get("user", {}) if users else {},
-        )
-        if not cluster.get("server"):
-            raise ValueError(f"kubeconfig {path}: cluster has no server URL")
-        self.server = cluster["server"].rstrip("/")
-        self._ssl_ctx: Optional[ssl.SSLContext] = None
-        if self.server.startswith("https"):
-            self._ssl_ctx = ssl.create_default_context()
-            ca_data = cluster.get("certificate-authority-data")
-            ca_file = cluster.get("certificate-authority")
-            if ca_data:
-                self._ssl_ctx.load_verify_locations(
-                    cadata=base64.b64decode(ca_data).decode()
-                )
-            elif ca_file:
-                self._ssl_ctx.load_verify_locations(cafile=ca_file)
-            if cluster.get("insecure-skip-tls-verify"):
-                self._ssl_ctx.check_hostname = False
-                self._ssl_ctx.verify_mode = ssl.CERT_NONE
-            cert_data = user.get("client-certificate-data")
-            key_data = user.get("client-key-data")
-            if cert_data and key_data:
-                # load_cert_chain needs files; materialize with 0600.
-                fd, certpath = tempfile.mkstemp(suffix=".pem")
-                with os.fdopen(fd, "wb") as fh:
-                    fh.write(base64.b64decode(cert_data))
-                    fh.write(b"\n")
-                    fh.write(base64.b64decode(key_data))
-                self._ssl_ctx.load_cert_chain(certpath)
-                os.unlink(certpath)
-            elif user.get("client-certificate"):
-                self._ssl_ctx.load_cert_chain(
-                    user["client-certificate"], user.get("client-key")
-                )
-        self.token = user.get("token", "")
-
-    # -- REST ----------------------------------------------------------
-    def _url(self, plural: str, suffix: str = "", query: str = "") -> str:
-        ns = f"/namespaces/{self.namespace}" if self.namespace else ""
-        u = f"{self.server}/apis/{GROUP}/{VERSION}{ns}/{plural}{suffix}"
-        return u + (f"?{query}" if query else "")
-
-    def _request(self, url: str, method: str = "GET",
-                 body: bytes | None = None,
-                 content_type: str = "application/json"):
-        req = urllib.request.Request(url, data=body, method=method)
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
-        if body is not None:
-            req.add_header("Content-Type", content_type)
-        return urllib.request.urlopen(req, context=self._ssl_ctx, timeout=300)
-
-    # -- list + watch --------------------------------------------------
-    def _ingest(self, kind: str, item: dict, event: str) -> None:
+    def _ingest(self, kind: str, event: str, item: dict) -> None:
         parse = KINDS[kind][1]
         if event in ("ADDED", "MODIFIED"):
             self.store.apply(kind, parse(item))
@@ -293,52 +211,38 @@ class KubeBridge:
             except KeyError:
                 pass
 
-    def _run_kind(self, kind: str, plural: str) -> None:
-        while not self._stop.is_set():
-            try:
-                with self._request(self._url(plural)) as resp:
-                    body = json.load(resp)
-                rv = body.get("metadata", {}).get("resourceVersion", "")
-                for item in body.get("items", []):
-                    self._ingest(kind, item, "ADDED")
-                # Watch from the list's resourceVersion; the apiserver
-                # streams one JSON object per line.
-                q = "watch=true" + (f"&resourceVersion={rv}" if rv else "")
-                with self._request(self._url(plural, query=q)) as stream:
-                    for line in stream:
-                        if self._stop.is_set():
-                            return
-                        if not line.strip():
-                            continue
-                        ev = json.loads(line)
-                        self._ingest(kind, ev.get("object", {}),
-                                     ev.get("type", ""))
-            except Exception as e:  # noqa: BLE001 — watch never dies
-                if self._stop.is_set():
-                    return
-                self._log.warning(
-                    "%s list/watch failed (%s: %s); retrying in %.1fs",
-                    plural, type(e).__name__, e, self.retry_s,
-                )
-            self._stop.wait(self.retry_s)
+    def _sync(self, kind: str, metas: list[dict]) -> None:
+        """Post-LIST resync: delete store objects the apiserver no longer
+        has (a CR deleted while the watch was down)."""
+        listed = {
+            f"{m.get('namespace', 'default')}/{m.get('name', '')}"
+            for m in metas
+        }
+        for obj in self.store.list(kind):
+            ns = getattr(obj, "namespace", "") or "default"
+            if f"{ns}/{obj.name}" not in listed:
+                try:
+                    self.store.delete(kind, obj.name, ns)
+                except KeyError:
+                    pass
 
     def patch_status(self, kind: str, obj: Any) -> None:
         """PATCH the status subresource (merge-patch), best effort."""
         plural = KINDS[kind][0]
         ns = getattr(obj, "namespace", "") or "default"
-        if self.namespace:
-            url = self._url(plural, suffix=f"/{obj.name}/status")
-        else:
-            url = (
-                f"{self.server}/apis/{GROUP}/{VERSION}/namespaces/{ns}/"
-                f"{plural}/{obj.name}/status"
-            )
+        url = self.client.url(
+            self.API_BASE, plural,
+            namespace=self.namespace or ns,
+            suffix=f"/{obj.name}/status",
+        )
         body = json.dumps(
             {"status": dataclasses.asdict(obj.status)}
         ).encode()
         try:
-            self._request(url, method="PATCH", body=body,
-                          content_type="application/merge-patch+json").close()
+            self.client.request(
+                url, method="PATCH", body=body,
+                content_type="application/merge-patch+json",
+            ).close()
         except Exception as e:  # noqa: BLE001
             self._log.warning("status patch %s/%s failed: %s",
                               kind, obj.name, e)
@@ -347,13 +251,27 @@ class KubeBridge:
     def start(self) -> None:
         for kind, (plural, _) in KINDS.items():
             t = threading.Thread(
-                target=self._run_kind, args=(kind, plural),
+                target=self.client.list_watch,
+                args=(self.API_BASE, plural),
+                kwargs={
+                    "on_event": (
+                        lambda ev, item, k=kind: self._ingest(k, ev, item)
+                    ),
+                    "stop": self._stop,
+                    "namespace": self.namespace,
+                    "retry_s": self.retry_s,
+                    "log": self._log,
+                    "on_sync": (
+                        lambda metas, k=kind: self._sync(k, metas)
+                    ),
+                },
                 name=f"kubebridge-{plural}", daemon=True,
             )
             t.start()
             self._threads.append(t)
         self._log.info("kube bridge watching %s at %s",
-                       ",".join(k for k, _ in KINDS.items()), self.server)
+                       ",".join(k for k, _ in KINDS.items()),
+                       self.client.server)
 
     def stop(self) -> None:
         self._stop.set()

@@ -1,0 +1,237 @@
+"""Core/v1 identity watchers (VERDICT r1 coverage #29/#39): pods,
+services, nodes from a (fake) kube-apiserver land in the identity cache
+exactly as CRD-store endpoint applies do."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import yaml
+
+from retina_tpu.controllers.cache import Cache
+from retina_tpu.operator.kubewatch import (
+    CoreWatcher,
+    node_to_node,
+    pod_to_endpoint,
+    service_to_svc,
+)
+
+
+# ------------------------------------------------------ pure translation
+def pod_doc(name="web-0", ns="default", ip="10.0.0.8", host_network=False,
+            deleting=False):
+    d = {
+        "metadata": {
+            "name": name, "namespace": ns,
+            "labels": {"app": "web"},
+            "annotations": {"retina.sh/trace": "on"},
+            "ownerReferences": [
+                {"kind": "StatefulSet", "name": "web"},
+            ],
+        },
+        "spec": {
+            "hostNetwork": host_network,
+            "nodeName": "node-a",
+            "containers": [{"name": "srv"}, {"name": "sidecar"}],
+        },
+        "status": {
+            "podIP": ip,
+            "podIPs": [{"ip": ip}] if ip else [],
+        },
+    }
+    if deleting:
+        d["metadata"]["deletionTimestamp"] = "2026-07-30T00:00:00Z"
+    return d
+
+
+def test_pod_to_endpoint_translation():
+    """pod/controller.go:61-86 semantics: slim endpoint, host-network and
+    IP-less pods ignored."""
+    ep = pod_to_endpoint(pod_doc())
+    assert ep.key() == "default/web-0"
+    assert ep.ips == ("10.0.0.8",)
+    assert dict(ep.labels)["app"] == "web"
+    assert ep.workload() == "web"  # top owner ref
+    assert ep.containers == ("srv", "sidecar")
+    assert ep.node == "node-a"
+
+    assert pod_to_endpoint(pod_doc(host_network=True)) is None
+    assert pod_to_endpoint(pod_doc(ip="")) is None
+
+
+def test_service_and_node_translation():
+    svc = service_to_svc({
+        "metadata": {"name": "api", "namespace": "prod"},
+        "spec": {"clusterIP": "10.96.0.5", "selector": {"app": "api"}},
+        "status": {"loadBalancer": {"ingress": [{"ip": "4.4.4.4"}]}},
+    })
+    assert svc.key() == "prod/api"
+    assert svc.cluster_ip == "10.96.0.5"
+    assert svc.lb_ip == "4.4.4.4"
+    # Headless services have no joinable VIP.
+    headless = service_to_svc({
+        "metadata": {"name": "h", "namespace": "d"},
+        "spec": {"clusterIP": "None"},
+    })
+    assert headless.cluster_ip == ""
+
+    node = node_to_node({
+        "metadata": {"name": "node-a",
+                     "labels": {"topology.kubernetes.io/zone": "z1"}},
+        "status": {"addresses": [
+            {"type": "Hostname", "address": "node-a"},
+            {"type": "InternalIP", "address": "192.168.1.10"},
+        ]},
+    })
+    assert node.ip == "192.168.1.10"
+    assert node.zone == "z1"
+
+
+# ------------------------------------------------- fake apiserver drive
+class FakeCoreApi(BaseHTTPRequestHandler):
+    pods: list[dict] = []
+    pod_events: list[dict] = []
+    services: list[dict] = []
+    nodes: list[dict] = []
+
+    def log_message(self, *a):  # noqa: D102
+        pass
+
+    def do_GET(self):  # noqa: N802
+        for plural, items, events in (
+            ("pods", FakeCoreApi.pods, FakeCoreApi.pod_events),
+            ("services", FakeCoreApi.services, []),
+            ("nodes", FakeCoreApi.nodes, []),
+        ):
+            if f"/{plural}" not in self.path:
+                continue
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            if "watch=true" in self.path:
+                for ev in events:
+                    self.wfile.write(json.dumps(ev).encode() + b"\n")
+                    self.wfile.flush()
+                time.sleep(0.5)
+            else:
+                self.wfile.write(json.dumps({
+                    "items": items,
+                    "metadata": {"resourceVersion": "3"},
+                }).encode())
+            return
+        self.send_response(404)
+        self.end_headers()
+
+
+@pytest.fixture()
+def core_apiserver(tmp_path):
+    FakeCoreApi.pods = [pod_doc("web-0", ip="10.0.0.8"),
+                        pod_doc("hostnet", ip="10.0.0.9",
+                                host_network=True)]
+    FakeCoreApi.pod_events = [
+        {"type": "ADDED", "object": pod_doc("web-1", ip="10.0.0.10")},
+        {"type": "DELETED", "object": pod_doc("web-0", ip="10.0.0.8")},
+    ]
+    FakeCoreApi.services = [{
+        "metadata": {"name": "api", "namespace": "default"},
+        "spec": {"clusterIP": "10.96.0.5", "selector": {"app": "web"}},
+    }]
+    FakeCoreApi.nodes = [{
+        "metadata": {"name": "node-a"},
+        "status": {"addresses": [
+            {"type": "InternalIP", "address": "192.168.1.10"}]},
+    }]
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), FakeCoreApi)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(yaml.safe_dump({
+        "current-context": "t",
+        "contexts": [{"name": "t",
+                      "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {
+            "server": f"http://127.0.0.1:{httpd.server_address[1]}"}}],
+        "users": [{"name": "u", "user": {"token": "tok"}}],
+    }))
+    yield str(kubeconfig)
+    httpd.shutdown()
+
+
+def test_resync_deletes_stale_objects(tmp_path):
+    """Informer resync semantics: a re-LIST after a dropped watch deletes
+    cache entries the apiserver no longer has (a missed DELETE must not
+    pin a dense pod index forever)."""
+    import yaml as _yaml
+
+    kc = tmp_path / "kc"
+    kc.write_text(_yaml.safe_dump({
+        "clusters": [{"name": "c",
+                      "cluster": {"server": "http://127.0.0.1:1"}}],
+        "contexts": [], "users": [],
+    }))
+    cache = Cache()
+    w = CoreWatcher(cache, str(kc))
+    cache.update_endpoint(pod_to_endpoint(pod_doc("old", ip="10.0.0.1")))
+    cache.update_endpoint(pod_to_endpoint(pod_doc("kept", ip="10.0.0.2")))
+    # apiserver's LIST only has "kept".
+    w._sync_pods([{"namespace": "default", "name": "kept"}])
+    assert cache.get_endpoint("default/old") is None
+    assert cache.get_endpoint("default/kept") is not None
+
+    from retina_tpu.common import RetinaSvc
+
+    cache.update_service(RetinaSvc(name="gone", namespace="default",
+                                   cluster_ip="10.96.0.9"))
+    w._sync_services([])
+    assert cache.get_obj_by_ip("10.96.0.9") is None
+
+
+def test_in_cluster_config(tmp_path, monkeypatch):
+    """kubeconfig='' + SA token mounted = in-cluster config, the
+    daemonset deployment path (client-go rest.InClusterConfig analog)."""
+    from retina_tpu.operator.kubeclient import (
+        KubeClient,
+        in_cluster_available,
+    )
+
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "token").write_text("sa-token\n")
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.96.0.1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+    assert in_cluster_available(str(sa))
+    c = KubeClient("", sa_dir=str(sa))
+    assert c.server == "https://10.96.0.1:443"
+    assert c.token == "sa-token"
+
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST")
+    assert not in_cluster_available(str(sa))
+    with pytest.raises(ValueError):
+        KubeClient("", sa_dir=str(sa))
+
+
+def test_corewatcher_feeds_cache(core_apiserver):
+    cache = Cache()
+    w = CoreWatcher(cache, core_apiserver, retry_s=5.0)
+    w.start()
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if (cache.get_endpoint("default/web-1") is not None
+                    and cache.get_endpoint("default/web-0") is None
+                    and cache.list_nodes()):
+                break
+            time.sleep(0.1)
+        # LIST pod applied then watch DELETED removed it; watch ADDED held.
+        assert cache.get_endpoint("default/web-0") is None
+        assert cache.get_endpoint("default/web-1") is not None
+        # Host-network pod never entered the cache.
+        assert cache.get_endpoint("default/hostnet") is None
+        # Pod IP is joinable (the enrichment path's lookup).
+        assert cache.get_obj_by_ip("10.0.0.10").name == "web-1"
+        # Service VIP and node landed too.
+        assert cache.get_obj_by_ip("10.96.0.5").name == "api"
+        assert cache.list_nodes()[0].ip == "192.168.1.10"
+    finally:
+        w.stop()
