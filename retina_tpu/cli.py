@@ -76,6 +76,10 @@ def cmd_operator(args: argparse.Namespace) -> int:
     if not args.watch_dir and not args.kubeconfig:
         print("operator: need --watch-dir or --kubeconfig", file=sys.stderr)
         return 2
+    if args.publish_cilium_crds and not args.kubeconfig:
+        print("operator: --publish-cilium-crds requires --kubeconfig",
+              file=sys.stderr)
+        return 2
     store = CRDStore()
     bridges = []
     sinks = []
@@ -93,6 +97,27 @@ def cmd_operator(args: argparse.Namespace) -> int:
                           namespace=args.namespace)
         bridges.append(kube)
         sinks.append(kube.patch_status)
+        if args.publish_cilium_crds:
+            # cilium-crds interop mode: watch core/v1 pods and publish
+            # CiliumEndpoint/CiliumIdentity CRs so cilium-ecosystem
+            # consumers get standard identity objects (reference
+            # operator cilium-crds cell).
+            from retina_tpu.controllers.cache import Cache
+            from retina_tpu.common.topics import TOPIC_PODS
+            from retina_tpu.operator.cilium import CiliumPublisher
+            from retina_tpu.operator.kubewatch import CoreWatcher
+            from retina_tpu.pubsub import PubSub
+
+            ps = PubSub()
+            pod_cache = Cache(pubsub=ps)
+            pub = CiliumPublisher(kube.client, node_name=args.node_name)
+            ps.subscribe(TOPIC_PODS, pub.on_pod_event)
+            pub.bootstrap()  # learn leftover CEP/CIDs from a prior run
+            bridges.append(CoreWatcher(
+                pod_cache, args.kubeconfig, namespace=args.namespace,
+                include_services=False, include_nodes=False,
+                on_pods_synced=pub.gc_stale,
+            ))
 
     def fan_out_status(kind, obj):
         for s in sinks:
@@ -314,6 +339,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="kubeconfig path (kube-apiserver backend)")
     o.add_argument("--namespace", default="",
                    help="namespace scope for --kubeconfig ('' = all)")
+    o.add_argument("--publish-cilium-crds", action="store_true",
+                   help="publish CiliumEndpoint/CiliumIdentity CRs from "
+                        "pods (cilium-crds interop mode)")
     o.add_argument("--node-name", default="local")
     o.add_argument("--poll-interval", type=float, default=2.0)
     o.set_defaults(fn=cmd_operator)

@@ -62,6 +62,10 @@ class Config:
     # feeding the cache (pkg/k8s watcher analog). "" = in-process only.
     kubeconfig: str = ""
     kube_namespace: str = ""  # namespace scope for pod/service watches
+    # Pod identity source when watching a cluster: "pods" (core/v1) or
+    # "cilium" (consume the Cilium CNI's CiliumEndpoints — the
+    # cilium-crds interop mode; services/nodes still come from core/v1).
+    identity_source: str = "pods"
 
     # --- multi-host distributed runtime (jax.distributed over DCN;
     # SURVEY.md §5.8: cross-slice merges ride the distributed runtime
@@ -107,6 +111,11 @@ class Config:
     identity_slots: int = 1 << 16
 
     def validate(self) -> None:
+        if self.identity_source not in ("pods", "cilium"):
+            raise ValueError(
+                f"identity_source must be 'pods' or 'cilium', "
+                f"got {self.identity_source!r}"
+            )
         if self.data_aggregation_level not in (AGG_LOW, AGG_HIGH):
             raise ValueError(
                 f"dataAggregationLevel must be {AGG_LOW!r} or {AGG_HIGH!r}, "

@@ -39,15 +39,27 @@ class Daemon:
         # Selected by an explicit kubeconfig OR automatically when running
         # in-cluster with a service account (the daemonset deployment).
         self.kubewatch = None
+        self.ciliumwatch = None
         from retina_tpu.operator.kubeclient import in_cluster_available
 
         if cfg.kubeconfig or in_cluster_available():
             from retina_tpu.operator.kubewatch import CoreWatcher
 
+            use_cilium = cfg.identity_source == "cilium"
             self.kubewatch = CoreWatcher(
                 self.cm.cache, cfg.kubeconfig,
                 namespace=cfg.kube_namespace,
+                include_pods=not use_cilium,
             )
+            if use_cilium:
+                # Identity from the foreign CNI's objects (cilium-crds
+                # interop): CEPs instead of core/v1 pods.
+                from retina_tpu.operator.cilium import CiliumWatcher
+
+                self.ciliumwatch = CiliumWatcher(
+                    self.cm.cache, cfg.kubeconfig,
+                    namespace=cfg.kube_namespace,
+                )
         self.metrics_module: Optional[MetricsModule] = None
         self._mm_thread: Optional[threading.Thread] = None
         self.hubble = None
@@ -162,9 +174,13 @@ class Daemon:
                         pass
         if self.kubewatch is not None:
             self.kubewatch.start()
+        if self.ciliumwatch is not None:
+            self.ciliumwatch.start()
         try:
             self.cm.start(stop)  # blocks until stop fires; runs shutdown
         finally:
+            if self.ciliumwatch is not None:
+                self.ciliumwatch.stop()
             if self.kubewatch is not None:
                 self.kubewatch.stop()
             if self.hubble is not None:

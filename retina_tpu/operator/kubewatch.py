@@ -105,11 +105,23 @@ class CoreWatcher:
     """
 
     def __init__(self, cache, kubeconfig: str, namespace: str = "",
-                 retry_s: float = 2.0):
+                 retry_s: float = 2.0, include_pods: bool = True,
+                 include_services: bool = True,
+                 include_nodes: bool = True,
+                 on_pods_synced=None):
+        """``include_pods=False`` watches only services+nodes — used when
+        pod identity comes from elsewhere (CiliumEndpoints); a pods-only
+        watcher (both others False) backs the operator's CEP publisher.
+        ``on_pods_synced()`` fires after each pod LIST resync — the
+        publisher's restart GC hook."""
         self._log = logger("kubewatch")
         self.cache = cache
         self.namespace = namespace  # "" = cluster-wide (pods/services)
         self.retry_s = retry_s
+        self.include_pods = include_pods
+        self.include_services = include_services
+        self.include_nodes = include_nodes
+        self.on_pods_synced = on_pods_synced
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.client = KubeClient(kubeconfig)
@@ -156,6 +168,8 @@ class CoreWatcher:
         for key in self.cache.list_endpoint_keys():
             if key not in listed:
                 self.cache.delete_endpoint(key)
+        if self.on_pods_synced is not None:
+            self.on_pods_synced()
 
     def _sync_services(self, metas: list[dict]) -> None:
         listed = self._keys(metas)
@@ -165,12 +179,15 @@ class CoreWatcher:
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
-        plans = [
-            ("pods", self._on_pod, self.namespace, self._sync_pods),
-            ("services", self._on_service, self.namespace,
-             self._sync_services),
-            ("nodes", self._on_node, "", None),  # nodes: cluster-scoped
-        ]
+        plans = []
+        if self.include_pods:
+            plans.append(("pods", self._on_pod, self.namespace,
+                          self._sync_pods))
+        if self.include_services:
+            plans.append(("services", self._on_service, self.namespace,
+                          self._sync_services))
+        if self.include_nodes:
+            plans.append(("nodes", self._on_node, "", None))  # cluster-scoped
         for plural, handler, ns, sync in plans:
             t = threading.Thread(
                 target=self.client.list_watch,
@@ -187,8 +204,8 @@ class CoreWatcher:
             )
             t.start()
             self._threads.append(t)
-        self._log.info("core/v1 watchers (pods,services,nodes) at %s",
-                       self.client.server)
+        self._log.info("core/v1 watchers (%s) at %s",
+                       ",".join(p[0] for p in plans), self.client.server)
 
     def stop(self) -> None:
         self._stop.set()
