@@ -84,6 +84,10 @@ class Config:
     synthetic_flows: int = 100_000
     capture_iface: str = ""  # live AF_PACKET interface ("" = default)
     external_socket: str = "/tmp/retina-events.sock"  # external feed
+    # pktmon plugin (Windows): stream-server command + its socket. ""
+    # command = the platform default (controller-pktmon.exe).
+    pktmon_command: str = ""
+    pktmon_socket: str = ""
 
     # --- TPU runtime knobs ---
     device_platform: str = ""  # "" = let JAX pick; "cpu" to force host

@@ -29,8 +29,11 @@ from retina_tpu.plugins import (  # noqa: F401
     tcpretrans,
 )
 
-if sys.platform == "win32":  # pragma: no cover - parity stubs
-    from retina_tpu.plugins import windows  # noqa: F401
+# Registered on every platform: the collector/parser logic is
+# cross-platform (and tested on Linux via injected sources); only the
+# default OS sources are win32-gated, raising UnsupportedPlatform from
+# init() elsewhere — which pluginmanager contains.
+from retina_tpu.plugins import windows  # noqa: E402,F401
 
 __all__ = [
     "EventSink",

@@ -13,30 +13,18 @@ from __future__ import annotations
 
 import os
 import socket
-import struct
 import threading
 
-import msgpack
-import numpy as np
-
 from retina_tpu.config import Config
-from retina_tpu.events.schema import NUM_FIELDS
 from retina_tpu.plugins import registry
 from retina_tpu.plugins.api import Plugin
-
-MAX_FRAME = 64 << 20
-
-
-def send_frame(sock: socket.socket, records: np.ndarray,
-               dns_names: dict[int, str] | None = None) -> None:
-    """Producer-side helper: ship a record block to the plugin socket."""
-    payload = msgpack.packb(
-        {
-            "records": np.ascontiguousarray(records, np.uint32).tobytes(),
-            "dns_names": dns_names or {},
-        }
-    )
-    sock.sendall(struct.pack("<I", len(payload)) + payload)
+from retina_tpu.plugins.framing import (  # noqa: F401 — re-exported API
+    MAX_FRAME,
+    decode_record_frame,
+    publish_dns_names,
+    read_frames,
+    send_frame,
+)
 
 
 @registry.register
@@ -61,44 +49,19 @@ class ExternalEventsPlugin(Plugin):
 
     def _serve_conn(self, conn: socket.socket, stop: threading.Event) -> None:
         conn.settimeout(0.2)
-        buf = b""
-        while not stop.is_set():
-            try:
-                chunk = conn.recv(1 << 20)
-            except (TimeoutError, socket.timeout):
-                continue
-            except OSError:
-                break
-            if not chunk:
-                break
-            buf += chunk
-            while len(buf) >= 4:
-                (n,) = struct.unpack_from("<I", buf)
-                if n > MAX_FRAME:
-                    self.log.error("frame too large (%d bytes); dropping conn", n)
-                    conn.close()
-                    return
-                if len(buf) < 4 + n:
-                    break
-                frame, buf = buf[4 : 4 + n], buf[4 + n :]
-                self._handle_frame(frame)
-        conn.close()
+        try:
+            read_frames(conn, stop, self._handle_frame, self.log)
+        finally:
+            conn.close()
 
     def _handle_frame(self, frame: bytes) -> None:
         try:
-            doc = msgpack.unpackb(frame, strict_map_key=False)
-            raw = doc["records"]
-            rec = np.frombuffer(raw, np.uint32).reshape(-1, NUM_FIELDS).copy()
+            rec, names = decode_record_frame(frame)
         except Exception:
             self.count_lost("decode", 1)
             self.log.exception("bad external frame")
             return
-        names = doc.get("dns_names") or {}
-        if names:
-            from retina_tpu.plugins.dns import TOPIC_DNS_NAMES
-            from retina_tpu.pubsub import get_pubsub
-
-            get_pubsub().publish(TOPIC_DNS_NAMES, dict(names))
+        publish_dns_names(names)
         self.emit(rec)
 
     def start(self, stop: threading.Event) -> None:
