@@ -282,10 +282,47 @@ def cmd_trace(args: argparse.Namespace) -> int:
 
 
 def cmd_shell(args: argparse.Namespace) -> int:
-    # Reference shell/ launches a debug pod with networking tools; local
-    # analog: an interactive shell with the agent env.
-    shell = os.environ.get("SHELL", "/bin/sh")
-    os.execvp(shell, [shell])
+    """Debug shell (reference cli/cmd/shell.go:46 + shell/):
+
+    - ``shell NODE --kubeconfig ...`` → host-network debug pod on the
+      node (+--mount-host-filesystem/--host-pid), attach, delete.
+    - ``shell pod/NAME --kubeconfig ...`` → ephemeral debug container.
+    - no kubeconfig → local diagnostic shell with agent env + banner.
+    """
+    from retina_tpu.shell import (
+        DEFAULT_IMAGE,
+        ShellConfig,
+        run_in_node,
+        run_in_pod,
+        run_local,
+    )
+
+    if not args.kubeconfig:
+        return run_local(api_addr=args.server,
+                         hubble_addr=args.hubble_server)
+    if not args.target:
+        print("shell: need a NODE or pod/NAME target", file=sys.stderr)
+        return 2
+    cfg = ShellConfig(
+        image=args.image or DEFAULT_IMAGE,
+        host_pid=args.host_pid,
+        capabilities=tuple(
+            c.strip() for c in args.capabilities.split(",") if c.strip()
+        ),
+        timeout_s=args.timeout,
+        mount_host_filesystem=args.mount_host_filesystem,
+        allow_host_filesystem_write=args.allow_host_filesystem_write,
+    )
+    target = args.target
+    try:
+        if target.startswith(("pod/", "pods/")):
+            name = target.split("/", 1)[1]
+            return run_in_pod(cfg, args.kubeconfig, args.namespace, name)
+        return run_in_node(cfg, args.kubeconfig, target,
+                           namespace=args.namespace)
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"shell: {e}", file=sys.stderr)
+        return 1
 
 
 def cmd_relay(args: argparse.Namespace) -> int:
@@ -396,6 +433,21 @@ def build_parser() -> argparse.ArgumentParser:
     tr.set_defaults(fn=cmd_trace)
 
     sh = sub.add_parser("shell", help="network debug shell")
+    sh.add_argument("target", nargs="?", default="",
+                    help="NODE or pod/NAME (cluster mode)")
+    sh.add_argument("--kubeconfig", default="",
+                    help="cluster mode; omit for a local debug shell")
+    sh.add_argument("--namespace", default="kube-system")
+    sh.add_argument("--image", default=None)
+    sh.add_argument("--capabilities", default="",
+                    help="comma-separated caps to add (e.g. NET_ADMIN)")
+    sh.add_argument("--host-pid", action="store_true")
+    sh.add_argument("--mount-host-filesystem", action="store_true")
+    sh.add_argument("--allow-host-filesystem-write", action="store_true")
+    sh.add_argument("--timeout", type=float, default=60.0)
+    sh.add_argument("--server", default="127.0.0.1:10093",
+                    help="agent address for the local banner")
+    sh.add_argument("--hubble-server", default="127.0.0.1:4244")
     sh.set_defaults(fn=cmd_shell)
 
     rl = sub.add_parser("relay", help="cluster-wide flow relay")
