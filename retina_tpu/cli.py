@@ -298,6 +298,13 @@ def cmd_shell(args: argparse.Namespace) -> int:
     )
 
     if not args.kubeconfig:
+        if args.target:
+            # Never silently debug the LOCAL machine when the user named
+            # a cluster target.
+            print(f"shell: target {args.target!r} needs --kubeconfig "
+                  f"(omit the target for a local debug shell)",
+                  file=sys.stderr)
+            return 2
         return run_local(api_addr=args.server,
                          hubble_addr=args.hubble_server)
     if not args.target:
@@ -316,10 +323,13 @@ def cmd_shell(args: argparse.Namespace) -> int:
     target = args.target
     try:
         if target.startswith(("pod/", "pods/")):
+            # Workload pods live in "default" unless told otherwise;
+            # kube-system is only the right default for node debug pods.
             name = target.split("/", 1)[1]
-            return run_in_pod(cfg, args.kubeconfig, args.namespace, name)
+            return run_in_pod(cfg, args.kubeconfig,
+                              args.namespace or "default", name)
         return run_in_node(cfg, args.kubeconfig, target,
-                           namespace=args.namespace)
+                           namespace=args.namespace or "kube-system")
     except Exception as e:  # noqa: BLE001 — CLI boundary
         print(f"shell: {e}", file=sys.stderr)
         return 1
@@ -437,7 +447,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="NODE or pod/NAME (cluster mode)")
     sh.add_argument("--kubeconfig", default="",
                     help="cluster mode; omit for a local debug shell")
-    sh.add_argument("--namespace", default="kube-system")
+    sh.add_argument("--namespace", default="",
+                    help="default: 'default' for pod/ targets, "
+                         "kube-system for node debug pods")
     sh.add_argument("--image", default=None)
     sh.add_argument("--capabilities", default="",
                     help="comma-separated caps to add (e.g. NET_ADMIN)")

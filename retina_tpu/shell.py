@@ -137,23 +137,52 @@ def validate_node_os(client: KubeClient, node_name: str) -> None:
 
 
 # -- wait + attach (attach.go) -----------------------------------------
+# Waiting reasons that will never resolve on their own — fail fast
+# instead of burning the whole timeout.
+_FATAL_WAIT_REASONS = {
+    "ErrImagePull", "ImagePullBackOff", "InvalidImageName",
+    "CreateContainerError", "CreateContainerConfigError",
+    "RunContainerError",
+}
+
+
 def wait_for_container_running(client: KubeClient, namespace: str,
                                pod_name: str, container: str,
                                timeout_s: float) -> None:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
-        with client.request(client.url(CORE_V1, "pods",
-                                       namespace=namespace,
-                                       suffix=f"/{pod_name}")) as r:
-            pod = json.load(r)
+        try:
+            with client.request(client.url(CORE_V1, "pods",
+                                           namespace=namespace,
+                                           suffix=f"/{pod_name}")) as r:
+                pod = json.load(r)
+        except Exception:  # noqa: BLE001 — transient apiserver blip:
+            time.sleep(1.0)  # keep polling until the deadline
+            continue
         statuses = (
             (pod.get("status", {}).get("containerStatuses") or [])
             + (pod.get("status", {}).get("ephemeralContainerStatuses") or [])
         )
         for st in statuses:
-            if st.get("name") == container and "running" in (
-                    st.get("state") or {}):
+            if st.get("name") != container:
+                continue
+            state = st.get("state") or {}
+            if "running" in state:
                 return
+            waiting = state.get("waiting") or {}
+            if waiting.get("reason") in _FATAL_WAIT_REASONS:
+                raise RuntimeError(
+                    f"container {container} cannot start: "
+                    f"{waiting.get('reason')} "
+                    f"({waiting.get('message', '')[:200]})"
+                )
+            term = state.get("terminated") or {}
+            if term:
+                raise RuntimeError(
+                    f"container {container} terminated "
+                    f"(exit {term.get('exitCode')}, "
+                    f"{term.get('reason', '')})"
+                )
         time.sleep(1.0)
     raise TimeoutError(
         f"container {container} in {namespace}/{pod_name} not running "
@@ -249,8 +278,8 @@ def run_in_node(cfg: ShellConfig, kubeconfig: str, node_name: str,
             # Never attached (no kubectl): keep the pod so the printed
             # manual attach command has a target.
             print(f"debug pod {namespace}/{name} left running; delete "
-                  f"it when done: kubectl -n {namespace} delete pod "
-                  f"{name}", file=sys.stderr)
+                  f"it when done: kubectl --kubeconfig {kubeconfig} "
+                  f"-n {namespace} delete pod {name}", file=sys.stderr)
         else:
             # Best-effort cleanup (shell.go:91-99).
             try:
