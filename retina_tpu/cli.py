@@ -73,11 +73,13 @@ def cmd_operator(args: argparse.Namespace) -> int:
     from retina_tpu.operator import CRDStore, Operator
 
     setup_logger()
-    if not args.watch_dir and not args.kubeconfig:
-        print("operator: need --watch-dir or --kubeconfig", file=sys.stderr)
+    use_kube = bool(args.kubeconfig) or args.in_cluster
+    if not args.watch_dir and not use_kube:
+        print("operator: need --watch-dir, --kubeconfig or --in-cluster",
+              file=sys.stderr)
         return 2
-    if args.publish_cilium_crds and not args.kubeconfig:
-        print("operator: --publish-cilium-crds requires --kubeconfig",
+    if args.publish_cilium_crds and not use_kube:
+        print("operator: --publish-cilium-crds requires a kube backend",
               file=sys.stderr)
         return 2
     store = CRDStore()
@@ -90,11 +92,16 @@ def cmd_operator(args: argparse.Namespace) -> int:
                         poll_interval=args.poll_interval)
         bridges.append(fb)
         sinks.append(fb.on_status)
-    if args.kubeconfig:
+    if use_kube:
         from retina_tpu.operator.bridge import KubeBridge
 
-        kube = KubeBridge(store, args.kubeconfig,
-                          namespace=args.namespace)
+        try:
+            # kubeconfig "" = in-cluster service-account config.
+            kube = KubeBridge(store, args.kubeconfig,
+                              namespace=args.namespace)
+        except (ValueError, OSError) as e:
+            print(f"operator: {e}", file=sys.stderr)
+            return 2
         bridges.append(kube)
         sinks.append(kube.patch_status)
         if args.publish_cilium_crds:
@@ -123,10 +130,32 @@ def cmd_operator(args: argparse.Namespace) -> int:
         for s in sinks:
             s(kind, obj)
 
+    elector = None
+    if args.leader_elect:
+        if not use_kube:
+            print("operator: --leader-elect requires a kube backend",
+                  file=sys.stderr)
+            return 2
+        if args.watch_dir:
+            # File-backend status is per-pod: each failover would re-run
+            # captures the old leader already completed.
+            print("operator: warning: --watch-dir with --leader-elect "
+                  "re-runs file-sourced captures on every failover; "
+                  "prefer apiserver CRs", file=sys.stderr)
+        from retina_tpu.operator.leaderelection import LeaderElector
+
+        elector = LeaderElector(
+            kube.client,
+            namespace=args.namespace or "kube-system",
+        )
     op = Operator(
         store, node_name=args.node_name,
         status_sink=fan_out_status if sinks else None,
+        leading=(elector.is_leader if elector else None),
     )
+    if elector is not None:
+        elector.on_started_leading = op.resync
+        elector.start()
     op.start()
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -135,6 +164,8 @@ def cmd_operator(args: argparse.Namespace) -> int:
         b.start()
     print("operator running (ctrl-c to stop)")
     stop.wait()
+    if elector is not None:
+        elector.stop()  # release the lease for fast failover
     for b in bridges:
         b.stop()
     return 0
@@ -384,11 +415,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory of CR YAMLs (file backend)")
     o.add_argument("--kubeconfig", default="",
                    help="kubeconfig path (kube-apiserver backend)")
+    o.add_argument("--in-cluster", action="store_true",
+                   help="kube backend via the mounted service account")
     o.add_argument("--namespace", default="",
                    help="namespace scope for --kubeconfig ('' = all)")
     o.add_argument("--publish-cilium-crds", action="store_true",
                    help="publish CiliumEndpoint/CiliumIdentity CRs from "
                         "pods (cilium-crds interop mode)")
+    o.add_argument("--leader-elect", action="store_true",
+                   help="coordinate replicas via a coordination.k8s.io "
+                        "Lease; followers watch but do not reconcile")
     o.add_argument("--node-name", default="local")
     o.add_argument("--poll-interval", type=float, default=2.0)
     o.set_defaults(fn=cmd_operator)

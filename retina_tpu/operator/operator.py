@@ -48,11 +48,18 @@ class Operator:
         nodes: Optional[list[RetinaNode]] = None,
         capture_manager: Optional[CaptureManager] = None,
         status_sink: Optional[Any] = None,
+        leading: Optional[Any] = None,
     ):
         """``status_sink(kind, obj)`` is called when an object's status
         settles — the kube backend passes KubeBridge.patch_status so
         status reaches the apiserver's status subresource
-        (controller.go:142 updateCaptureStatusFromJobs analog)."""
+        (controller.go:142 updateCaptureStatusFromJobs analog).
+
+        ``leading()`` gates side-effectful reconciles (captures): a
+        follower replica watches but does not act (controller-runtime
+        leader election analog, operator/cmd/root.go:21-39). Call
+        :meth:`resync` when leadership is gained so objects applied
+        while following get reconciled."""
         self._log = logger("operator")
         self.store = store
         self.cache = cache
@@ -62,6 +69,7 @@ class Operator:
         self.nodes = nodes or [RetinaNode(name=node_name)]
         self.capture_manager = capture_manager or CaptureManager()
         self.status_sink = status_sink
+        self.leading = leading or (lambda: True)
         self._jobs: dict[str, threading.Thread] = {}
         self._jobs_lock = threading.Lock()
 
@@ -82,9 +90,34 @@ class Operator:
         self._log.info("operator started (node=%s)", self.node_name)
 
     # -- capture reconcile (controller.go:102) -------------------------
+    def resync(self) -> None:
+        """Leadership-gained hook: reconcile every Pending capture, and
+        fail captures stuck Running from a dead leader — their "jobs"
+        were threads in that process, so nobody will ever complete them
+        (unlike the reference, whose k8s Jobs outlive the operator)."""
+        for cap in self.store.list(KIND_CAPTURE):
+            if cap.status.phase == "Running":
+                key = f"{cap.namespace}/{cap.name}"
+                with self._jobs_lock:
+                    mine = self._jobs.get(key)
+                if mine is None or not mine.is_alive():
+                    cap.status.phase = "Failed"
+                    cap.status.jobs_failed += cap.status.jobs_active
+                    cap.status.jobs_active = 0
+                    cap.status.message = (
+                        "orphaned by leader failover; re-apply to retry"
+                    )
+                    self._log.warning("capture %s orphaned by failover",
+                                      cap.name)
+                    self._sync_status(KIND_CAPTURE, cap)
+                continue
+            self._on_capture("applied", cap)
+
     def _on_capture(self, event: str, cap: Capture) -> None:
         if event != "applied" or cap.status.phase not in ("Pending",):
             return
+        if not self.leading():
+            return  # follower: watch only; resync() runs these later
         # Dedupe: a watch reconnect can re-LIST an in-flight capture whose
         # apiserver copy still says Pending; don't start a duplicate job.
         key = f"{cap.namespace}/{cap.name}"
