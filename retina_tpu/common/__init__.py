@@ -6,6 +6,8 @@ objects (endpoint.go), DirtyCache (dirtycache.go), pubsub topic constants
 """
 
 from retina_tpu.common.objects import (
+    POD_ANNOTATION,
+    POD_ANNOTATION_VALUE,
     DirtyCache,
     IPFamily,
     RetinaEndpoint,
@@ -16,6 +18,7 @@ from retina_tpu.common.objects import (
 from retina_tpu.common.topics import (
     TOPIC_APISERVER,
     TOPIC_ENDPOINTS,
+    TOPIC_NAMESPACES,
     TOPIC_NODES,
     TOPIC_PODS,
     TOPIC_SERVICES,
@@ -29,8 +32,11 @@ __all__ = [
     "RetinaNode",
     "RetinaSvc",
     "retry",
+    "POD_ANNOTATION",
+    "POD_ANNOTATION_VALUE",
     "TOPIC_APISERVER",
     "TOPIC_ENDPOINTS",
+    "TOPIC_NAMESPACES",
     "TOPIC_NODES",
     "TOPIC_PODS",
     "TOPIC_SERVICES",

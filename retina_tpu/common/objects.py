@@ -25,6 +25,12 @@ class IPFamily:
     IPv6 = "v6"
 
 
+# Pod/namespace pod-level opt-in annotation (reference
+# common/types.go:17-18): retina.sh=observe.
+POD_ANNOTATION = "retina.sh"
+POD_ANNOTATION_VALUE = "observe"
+
+
 @dataclasses.dataclass(frozen=True)
 class RetinaEndpoint:
     """Slim pod identity (reference pkg/common/endpoint.go)."""

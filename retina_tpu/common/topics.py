@@ -5,4 +5,5 @@ TOPIC_APISERVER = "apiserver"  # apiserver IP set changes
 TOPIC_PODS = "pods"  # pod identity add/update/delete
 TOPIC_SERVICES = "services"
 TOPIC_NODES = "nodes"
+TOPIC_NAMESPACES = "namespaces"  # annotated-namespace set changes
 TOPIC_SNAPSHOT = "snapshot"  # sketch-state snapshot announcements

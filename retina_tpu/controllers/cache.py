@@ -19,6 +19,7 @@ from retina_tpu.common import (
     RetinaEndpoint,
     RetinaNode,
     RetinaSvc,
+    TOPIC_NAMESPACES,
     TOPIC_PODS,
     TOPIC_SERVICES,
 )
@@ -44,6 +45,10 @@ class Cache:
         self._free_indices: list[int] = []
         self._next_index = 1  # 0 reserved for unknown/world
         self._dirty_cbs: list[Callable[[], None]] = []
+        # Namespaces carrying the retina.sh=observe annotation — the
+        # annotation-driven pod-level opt-in set
+        # (cache.AddAnnotatedNamespace, namespace_controller.go:54-62).
+        self._annotated_ns: set[str] = set()
 
     # -- dirty notification (identity table rebuild trigger) ----------
     def on_identity_change(self, cb: Callable[[], None]) -> None:
@@ -139,6 +144,30 @@ class Cache:
         """All ns/name endpoint keys (informer resync diff support)."""
         with self._lock:
             return list(self._eps.keys())
+
+    def endpoints_in_namespace(self, ns: str) -> list[RetinaEndpoint]:
+        with self._lock:
+            return [ep for ep in self._eps.values()
+                    if ep.namespace == ns]
+
+    # -- annotated namespaces (namespace_controller.go analog) --------
+    def set_annotated_namespace(self, ns: str, annotated: bool) -> None:
+        with self._lock:
+            if annotated == (ns in self._annotated_ns):
+                return
+            if annotated:
+                self._annotated_ns.add(ns)
+            else:
+                self._annotated_ns.discard(ns)
+        if self._ps:
+            self._ps.publish(
+                TOPIC_NAMESPACES,
+                ("annotated" if annotated else "unannotated", ns),
+            )
+
+    def annotated_namespaces(self) -> set[str]:
+        with self._lock:
+            return set(self._annotated_ns)
 
     def list_service_keys(self) -> list[str]:
         with self._lock:

@@ -50,10 +50,21 @@ class Daemon:
                 self.cm.cache, cfg.kubeconfig,
                 namespace=cfg.kube_namespace,
                 include_pods=not use_cilium,
+                include_namespaces=cfg.enable_annotations,
             )
             if use_cilium:
                 # Identity from the foreign CNI's objects (cilium-crds
                 # interop): CEPs instead of core/v1 pods.
+                if cfg.enable_annotations:
+                    # CEPs carry identity labels, not pod annotations:
+                    # per-POD retina.sh=observe opt-in cannot work in
+                    # this mode; namespace-level opt-in still does.
+                    self.log.warning(
+                        "identity_source=cilium: per-pod observe "
+                        "annotations are invisible (CiliumEndpoints "
+                        "carry no pod annotations); use the namespace "
+                        "annotation instead"
+                    )
                 from retina_tpu.operator.cilium import CiliumWatcher
 
                 self.ciliumwatch = CiliumWatcher(

@@ -11,6 +11,7 @@ debounced rebuilds of that table.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Callable, Optional
 
@@ -32,6 +33,8 @@ class FilterManager:
         self._refs: dict[int, set[tuple[str, str]]] = {}
         self._apply = apply_fn
         self._retries = max_retries
+        self._deferring = 0
+        self._dirty = False
 
     def _push(self) -> None:
         if self._apply is None:
@@ -40,6 +43,30 @@ class FilterManager:
             ips = set(self._refs)
         retry(lambda: self._apply(ips), attempts=self._retries,
               base_delay_s=0.05)
+
+    def _maybe_push(self) -> None:
+        with self._lock:
+            if self._deferring:
+                self._dirty = True
+                return
+        self._push()
+
+    @contextlib.contextmanager
+    def deferred_push(self):
+        """Batch many add/delete calls into ONE table push — e.g. a
+        namespace annotation toggle resyncing every pod in it."""
+        with self._lock:
+            self._deferring += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._deferring -= 1
+                do = self._deferring == 0 and self._dirty
+                if do:
+                    self._dirty = False
+            if do:
+                self._push()
 
     def add_ips(self, ips: list[int], requestor: str, rule_id: str) -> None:
         """Refcounted add (manager_linux.go AddIPs :62-100)."""
@@ -51,7 +78,7 @@ class FilterManager:
                     changed = True
                 refs.add((requestor, rule_id))
         if changed:
-            self._push()
+            self._maybe_push()
 
     def delete_ips(self, ips: list[int], requestor: str, rule_id: str) -> None:
         """Deletes only when the last (requestor, rule) drops its ref."""
@@ -66,7 +93,7 @@ class FilterManager:
                     del self._refs[ip]
                     changed = True
         if changed:
-            self._push()
+            self._maybe_push()
 
     def has_ip(self, ip: int) -> bool:
         with self._lock:

@@ -20,7 +20,12 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
-from retina_tpu.common import TOPIC_PODS
+from retina_tpu.common import (
+    POD_ANNOTATION,
+    POD_ANNOTATION_VALUE,
+    TOPIC_NAMESPACES,
+    TOPIC_PODS,
+)
 from retina_tpu.config import Config
 from retina_tpu.controllers.cache import Cache
 from retina_tpu.crd.types import MetricsConfiguration, MetricsSpec
@@ -60,22 +65,58 @@ class MetricsModule:
         self._spec: MetricsSpec = MetricsSpec()
         if pubsub is not None:
             pubsub.subscribe(TOPIC_PODS, self._on_pod_event)
+            pubsub.subscribe(TOPIC_NAMESPACES, self._on_namespace_event)
+
+    # -- annotation opt-in (metrics_module.go:575-595 podAnnotated) ---
+    def _pod_of_interest(self, ep) -> bool:
+        """With enable_annotations, only pods carrying retina.sh=observe
+        (or living in an annotated namespace) are tracked; otherwise
+        every pod is."""
+        if not self.cfg.enable_annotations:
+            return True
+        if dict(ep.annotations).get(POD_ANNOTATION) == POD_ANNOTATION_VALUE:
+            return True
+        return ep.namespace in self.cache.annotated_namespaces()
 
     # -- dirty-pod → filtermanager sync (metrics_module.go run loop) --
     def _on_pod_event(self, msg: tuple) -> None:
+        """Pubsub callbacks run on a pool with NO ordering guarantee, so
+        the decision is derived from the cache's CURRENT state, not the
+        event payload — stale events then converge to the same verdict
+        as fresh ones instead of inverting it."""
         if self.fm is None:
             return
-        ev, ep = msg
+        _ev, ep = msg
         try:
-            ips = [ip_to_u32(ip) for ip in ep.ips]
+            event_ips = [ip_to_u32(ip) for ip in ep.ips]
         except (ValueError, AttributeError):
             return
-        if not ips:
+        current = self.cache.get_endpoint(ep.key())
+        if current is not None and self._pod_of_interest(current):
+            cur_ips = [ip_to_u32(ip) for ip in current.ips]
+            self.fm.add_ips(cur_ips, "metrics-module", ep.key())
+            stale = [ip for ip in event_ips if ip not in set(cur_ips)]
+            if stale:  # pod changed IPs across updates
+                self.fm.delete_ips(stale, "metrics-module", ep.key())
+        else:
+            # Deleted, opted out, or annotation dropped on update.
+            cur_ips = (
+                [ip_to_u32(ip) for ip in current.ips]
+                if current is not None else []
+            )
+            self.fm.delete_ips(sorted(set(event_ips) | set(cur_ips)),
+                               "metrics-module", ep.key())
+
+    def _on_namespace_event(self, msg: tuple) -> None:
+        """A namespace gained/lost the observe annotation: resync every
+        pod already in it in ONE filter-table push
+        (namespace_controller.go Start loop)."""
+        if self.fm is None or not self.cfg.enable_annotations:
             return
-        if ev in ("added", "updated"):
-            self.fm.add_ips(ips, "metrics-module", ep.key())
-        elif ev == "deleted":
-            self.fm.delete_ips(ips, "metrics-module", ep.key())
+        _ev, ns = msg
+        with self.fm.deferred_push():
+            for ep in self.cache.endpoints_in_namespace(ns):
+                self._on_pod_event(("updated", ep))
 
     # -- reconcile (metrics_module.go:142-175, :205-263) ---------------
     def reconcile(self, conf: MetricsConfiguration) -> None:

@@ -269,7 +269,12 @@ class CiliumPublisher:
 
 # ---------------------------------------------------------------------
 def cep_to_endpoint(doc: dict) -> Optional[RetinaEndpoint]:
-    """CiliumEndpoint → RetinaEndpoint (the consume direction)."""
+    """CiliumEndpoint → RetinaEndpoint (the consume direction).
+
+    CEPs carry security labels, not pod annotations, so the resulting
+    endpoint has an empty ``annotations`` tuple — per-pod
+    retina.sh=observe opt-in is unavailable in cilium identity mode
+    (the daemon warns; namespace-level opt-in still works)."""
     meta = doc.get("metadata", {}) or {}
     status = doc.get("status", {}) or {}
     net = status.get("networking", {}) or {}

@@ -22,7 +22,13 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-from retina_tpu.common import RetinaEndpoint, RetinaNode, RetinaSvc
+from retina_tpu.common import (
+    POD_ANNOTATION,
+    POD_ANNOTATION_VALUE,
+    RetinaEndpoint,
+    RetinaNode,
+    RetinaSvc,
+)
 from retina_tpu.log import logger
 from retina_tpu.operator.kubeclient import KubeClient
 
@@ -108,12 +114,14 @@ class CoreWatcher:
                  retry_s: float = 2.0, include_pods: bool = True,
                  include_services: bool = True,
                  include_nodes: bool = True,
+                 include_namespaces: bool = False,
                  on_pods_synced=None):
         """``include_pods=False`` watches only services+nodes — used when
         pod identity comes from elsewhere (CiliumEndpoints); a pods-only
-        watcher (both others False) backs the operator's CEP publisher.
-        ``on_pods_synced()`` fires after each pod LIST resync — the
-        publisher's restart GC hook."""
+        watcher (others False) backs the operator's CEP publisher.
+        ``include_namespaces`` adds the annotated-namespace watch (the
+        enable_annotations opt-in path). ``on_pods_synced()`` fires after
+        each pod LIST resync — the publisher's restart GC hook."""
         self._log = logger("kubewatch")
         self.cache = cache
         self.namespace = namespace  # "" = cluster-wide (pods/services)
@@ -121,6 +129,7 @@ class CoreWatcher:
         self.include_pods = include_pods
         self.include_services = include_services
         self.include_nodes = include_nodes
+        self.include_namespaces = include_namespaces
         self.on_pods_synced = on_pods_synced
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -153,6 +162,21 @@ class CoreWatcher:
         if event != "DELETED":
             self.cache.update_node(node_to_node(doc))
 
+    def _on_namespace(self, event: str, doc: dict) -> None:
+        """namespace_controller.go:54-62: the retina.sh=observe
+        annotation opts a whole namespace into pod-level metrics."""
+        meta = doc.get("metadata", {}) or {}
+        name = meta.get("name", "")
+        if not name:
+            return
+        annotated = (
+            event != "DELETED"
+            and meta.get("deletionTimestamp") is None
+            and (meta.get("annotations") or {}).get(POD_ANNOTATION)
+            == POD_ANNOTATION_VALUE
+        )
+        self.cache.set_annotated_namespace(name, annotated)
+
     # -- resync (informer semantics): a re-LIST after a dropped watch
     # must delete objects that vanished while disconnected, or stale
     # endpoints pin dense pod indexes forever.
@@ -177,6 +201,15 @@ class CoreWatcher:
             if key not in listed:
                 self.cache.delete_service(key)
 
+    def _sync_namespaces(self, metas: list[dict]) -> None:
+        annotated = {
+            m.get("name", "") for m in metas
+            if (m.get("annotations") or {}).get(POD_ANNOTATION)
+            == POD_ANNOTATION_VALUE
+        }
+        for ns in self.cache.annotated_namespaces() - annotated:
+            self.cache.set_annotated_namespace(ns, False)
+
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
         plans = []
@@ -188,6 +221,9 @@ class CoreWatcher:
                           self._sync_services))
         if self.include_nodes:
             plans.append(("nodes", self._on_node, "", None))  # cluster-scoped
+        if self.include_namespaces:
+            plans.append(("namespaces", self._on_namespace, "",
+                          self._sync_namespaces))
         for plural, handler, ns, sync in plans:
             t = threading.Thread(
                 target=self.client.list_watch,

@@ -1,0 +1,146 @@
+"""Annotation-driven pod-level opt-in (VERDICT r1 coverage #39):
+enable_annotations gates the metrics-module filter set to pods carrying
+retina.sh=observe or living in an annotated namespace, fed by the
+namespace watch (reference namespace_controller.go + podAnnotated,
+metrics_module.go:575-595)."""
+
+import pytest
+
+from retina_tpu.common import RetinaEndpoint
+from retina_tpu.config import Config
+from retina_tpu.controllers.cache import Cache
+from retina_tpu.events.schema import ip_to_u32
+from retina_tpu.exporter import Exporter
+from retina_tpu.exporter import reset_for_tests as reset_exporter
+from retina_tpu.managers.filtermanager import FilterManager
+from retina_tpu.metrics import reset_for_tests as reset_metrics
+from retina_tpu.module.metrics_module import MetricsModule
+from retina_tpu.operator.kubewatch import CoreWatcher
+from retina_tpu.pubsub import PubSub
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    reset_exporter()
+    reset_metrics()
+    yield
+    reset_exporter()
+    reset_metrics()
+
+
+class NullEngine:
+    def snapshot(self):
+        return {}
+
+
+def mk_module(enable_annotations: bool):
+    cfg = Config()
+    cfg.enable_annotations = enable_annotations
+    ps = PubSub()
+    cache = Cache(pubsub=ps)
+    fm = FilterManager()
+    mm = MetricsModule(cfg, engine=NullEngine(), cache=cache,
+                       filtermanager=fm, pubsub=ps,
+                       exporter=Exporter())
+    return cache, fm, mm, ps
+
+
+def ep(name, ns="default", ip="10.0.0.1", annotated=False):
+    return RetinaEndpoint(
+        name=name, namespace=ns, ips=(ip,),
+        annotations=(("retina.sh", "observe"),) if annotated else (),
+    )
+
+
+def wait_for(cond, timeout_s=5.0):
+    """Pubsub callbacks run on a pool; poll instead of fixed sleeps."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+def test_annotations_off_tracks_every_pod():
+    cache, fm, mm, ps = mk_module(enable_annotations=False)
+    cache.update_endpoint(ep("a", ip="10.0.0.1"))
+    assert wait_for(lambda: fm.has_ip(ip_to_u32("10.0.0.1")))
+
+
+def test_annotations_on_gates_to_annotated_pods():
+    cache, fm, mm, ps = mk_module(enable_annotations=True)
+    cache.update_endpoint(ep("plain", ip="10.0.0.1"))
+    cache.update_endpoint(ep("tagged", ip="10.0.0.2", annotated=True))
+    assert wait_for(lambda: fm.has_ip(ip_to_u32("10.0.0.2")))
+    assert not fm.has_ip(ip_to_u32("10.0.0.1"))
+
+    # Removing the annotation on update drops the pod from the set.
+    cache.update_endpoint(ep("tagged", ip="10.0.0.2", annotated=False))
+    assert wait_for(lambda: not fm.has_ip(ip_to_u32("10.0.0.2")))
+
+
+def test_annotated_namespace_opts_in_existing_pods():
+    cache, fm, mm, ps = mk_module(enable_annotations=True)
+    cache.update_endpoint(ep("a", ns="prod", ip="10.0.1.1"))
+    cache.update_endpoint(ep("b", ns="prod", ip="10.0.1.2"))
+    cache.update_endpoint(ep("c", ns="dev", ip="10.0.2.1"))
+    assert wait_for(lambda: cache.pod_count() == 3)
+    assert fm.ip_count() == 0
+
+    # Namespace becomes annotated: pods already in it get tracked.
+    cache.set_annotated_namespace("prod", True)
+    assert wait_for(lambda: fm.has_ip(ip_to_u32("10.0.1.1"))
+                    and fm.has_ip(ip_to_u32("10.0.1.2")))
+    assert not fm.has_ip(ip_to_u32("10.0.2.1"))
+
+    # New pod in the annotated namespace is tracked on arrival.
+    cache.update_endpoint(ep("d", ns="prod", ip="10.0.1.3"))
+    assert wait_for(lambda: fm.has_ip(ip_to_u32("10.0.1.3")))
+
+    # Unannotating clears namespace-derived entries.
+    cache.set_annotated_namespace("prod", False)
+    assert wait_for(lambda: not fm.has_ip(ip_to_u32("10.0.1.1"))
+                    and not fm.has_ip(ip_to_u32("10.0.1.3")))
+
+
+def test_namespace_watch_handler_sets_cache():
+    """CoreWatcher._on_namespace / _sync_namespaces translate namespace
+    docs into the annotated set without an apiserver."""
+    import yaml
+
+    kcdoc = {"clusters": [{"name": "c", "cluster": {
+        "server": "http://127.0.0.1:1"}}], "contexts": [], "users": []}
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".kc",
+                                     delete=False) as fh:
+        yaml.safe_dump(kcdoc, fh)
+        kc = fh.name
+    cache = Cache()
+    w = CoreWatcher(cache, kc, include_namespaces=True)
+
+    def ns_doc(name, observe=True, deleting=False):
+        meta = {"name": name}
+        if observe:
+            meta["annotations"] = {"retina.sh": "observe"}
+        if deleting:
+            meta["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+        return {"metadata": meta}
+
+    w._on_namespace("ADDED", ns_doc("prod"))
+    assert cache.annotated_namespaces() == {"prod"}
+    # Annotation removed on update.
+    w._on_namespace("MODIFIED", ns_doc("prod", observe=False))
+    assert cache.annotated_namespaces() == set()
+    # Deleting namespace never counts.
+    w._on_namespace("MODIFIED", ns_doc("prod", deleting=True))
+    assert cache.annotated_namespaces() == set()
+    # Resync clears namespaces no longer annotated in the LIST.
+    w._on_namespace("ADDED", ns_doc("stale"))
+    w._on_namespace("ADDED", ns_doc("kept"))
+    w._sync_namespaces([{"name": "kept",
+                         "annotations": {"retina.sh": "observe"}}])
+    assert cache.annotated_namespaces() == {"kept"}
