@@ -23,3 +23,19 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# Every test starts from fresh exporter/metrics singletons: modules used
+# to carry identical per-file autouse fixtures for this (review finding);
+# the reset is cheap and global state bleed between tests is never wanted.
+import pytest  # noqa: E402
+
+from retina_tpu.exporter import reset_for_tests as _reset_exporter  # noqa: E402
+from retina_tpu.metrics import reset_for_tests as _reset_metrics  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metric_singletons():
+    _reset_exporter()
+    _reset_metrics()
+    yield

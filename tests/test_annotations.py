@@ -11,21 +11,10 @@ from retina_tpu.config import Config
 from retina_tpu.controllers.cache import Cache
 from retina_tpu.events.schema import ip_to_u32
 from retina_tpu.exporter import Exporter
-from retina_tpu.exporter import reset_for_tests as reset_exporter
 from retina_tpu.managers.filtermanager import FilterManager
-from retina_tpu.metrics import reset_for_tests as reset_metrics
 from retina_tpu.module.metrics_module import MetricsModule
 from retina_tpu.operator.kubewatch import CoreWatcher
 from retina_tpu.pubsub import PubSub
-
-
-@pytest.fixture(autouse=True)
-def fresh():
-    reset_exporter()
-    reset_metrics()
-    yield
-    reset_exporter()
-    reset_metrics()
 
 
 class NullEngine:

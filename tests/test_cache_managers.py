@@ -24,8 +24,6 @@ from retina_tpu.watchers.endpoint import EndpointWatcher
 
 @pytest.fixture(autouse=True)
 def fresh_metrics():
-    reset_exporter()
-    reset_metrics()
     yield
     MockPlugin.fail_stage = None
 

@@ -1,0 +1,87 @@
+"""Deploy manifests stay coherent with the code: every YAML parses, the
+CRDs cover exactly the kinds the kube bridge watches (with the status
+subresource the operator PATCHes), and RBAC grants what the watchers and
+the leader elector actually use."""
+
+import glob
+import os
+
+import yaml
+
+DEPLOY = os.path.join(os.path.dirname(__file__), "..", "deploy",
+                      "manifests")
+
+
+def load_all():
+    docs = []
+    for path in sorted(glob.glob(os.path.join(DEPLOY, "*.yaml"))):
+        with open(path) as fh:
+            docs.extend(d for d in yaml.safe_load_all(fh) if d)
+    return docs
+
+
+def test_all_manifests_parse():
+    docs = load_all()
+    kinds = {d["kind"] for d in docs}
+    assert {"CustomResourceDefinition", "DaemonSet", "Deployment",
+            "ConfigMap", "ServiceAccount", "ClusterRole",
+            "ClusterRoleBinding"} <= kinds
+
+
+def test_crds_match_kube_bridge():
+    from retina_tpu.operator.bridge import GROUP, KINDS
+
+    crds = [d for d in load_all()
+            if d["kind"] == "CustomResourceDefinition"]
+    by_plural = {d["spec"]["names"]["plural"]: d for d in crds}
+    assert set(by_plural) == {p for p, _ in KINDS.values()}
+    for kind, (plural, _) in KINDS.items():
+        crd = by_plural[plural]
+        assert crd["spec"]["group"] == GROUP
+        assert crd["spec"]["names"]["kind"] == kind
+        v = crd["spec"]["versions"][0]
+        assert v["name"] == "v1alpha1"
+        # Operator PATCHes /status; without the subresource that 404s.
+        assert v["subresources"] == {"status": {}}
+
+
+def test_rbac_covers_watched_resources():
+    roles = {d["metadata"]["name"]: d for d in load_all()
+             if d["kind"] == "ClusterRole"}
+
+    def verbs_for(role, group, resource) -> set:
+        out = set()
+        for r in roles[role]["rules"]:
+            if group in r["apiGroups"] and resource in r["resources"]:
+                out.update(r["verbs"])
+        return out
+
+    # Agent list+watches core/v1 pods/services/nodes/namespaces
+    # (kubeclient.list_watch does LIST then WATCH).
+    for res in ("pods", "services", "nodes", "namespaces"):
+        assert {"list", "watch"} <= verbs_for("retina-tpu-agent", "",
+                                              res), res
+    # Operator list+watches the retina.sh CRs and merge-PATCHes status
+    # (bridge.py patch_status).
+    assert {"list", "watch"} <= verbs_for("retina-tpu-operator",
+                                          "retina.sh", "captures")
+    assert "patch" in verbs_for("retina-tpu-operator", "retina.sh",
+                                "captures/status")
+    # Leader elector: GET + POST create + PUT renew on leases
+    # (leaderelection.py _get_lease/_write_lease).
+    lease_verbs = verbs_for("retina-tpu-operator",
+                            "coordination.k8s.io", "leases")
+    assert {"get", "create", "update"} <= lease_verbs
+
+
+def test_operator_deployment_uses_leader_election():
+    deps = [d for d in load_all() if d["kind"] == "Deployment"
+            and d["metadata"]["name"] == "retina-tpu-operator"]
+    assert deps
+    spec = deps[0]["spec"]
+    args = spec["template"]["spec"]["containers"][0]["args"]
+    if spec["replicas"] > 1:
+        assert "--leader-elect" in args
+        # File-backend captures would re-run per failover (per-pod
+        # status); multi-replica must not use --watch-dir.
+        assert "--watch-dir" not in args

@@ -22,15 +22,6 @@ from retina_tpu.events.schema import (
     VERDICT_FORWARDED,
 )
 from retina_tpu.events.synthetic import POD_NET, TrafficGen
-from retina_tpu.exporter import reset_for_tests as reset_exporter
-from retina_tpu.metrics import reset_for_tests as reset_metrics
-
-
-@pytest.fixture(autouse=True)
-def fresh_metrics():
-    reset_exporter()
-    reset_metrics()
-    yield
 
 
 def small_cfg(**kw) -> Config:

@@ -14,17 +14,8 @@ import pytest
 from retina_tpu.config import Config
 from retina_tpu.engine import SketchEngine
 from retina_tpu.events.schema import F, NUM_FIELDS
-from retina_tpu.exporter import reset_for_tests as reset_exporter
-from retina_tpu.metrics import reset_for_tests as reset_metrics
 from retina_tpu.plugins.api import QueueSink
 from retina_tpu.plugins.packetparser import PacketParserPlugin
-
-
-@pytest.fixture(autouse=True)
-def fresh_metrics():
-    reset_exporter()
-    reset_metrics()
-    yield
 
 
 def _can_af_packet() -> bool:

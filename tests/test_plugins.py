@@ -29,8 +29,6 @@ from retina_tpu.plugins.tcpretrans import TcpRetransPlugin
 
 @pytest.fixture(autouse=True)
 def fresh_metrics():
-    reset_exporter()
-    reset_metrics()
     yield
     MockPlugin.fail_stage = None
 

@@ -7,20 +7,10 @@ import threading
 import time
 
 import numpy as np
-import pytest
 
 from retina_tpu.config import Config
 from retina_tpu.engine import SketchEngine
 from retina_tpu.events.schema import NUM_FIELDS
-from retina_tpu.exporter import reset_for_tests as reset_exporter
-from retina_tpu.metrics import reset_for_tests as reset_metrics
-
-
-@pytest.fixture(autouse=True)
-def fresh_metrics():
-    reset_exporter()
-    reset_metrics()
-    yield
 
 
 def small_cfg() -> Config:
