@@ -82,6 +82,10 @@ def cmd_operator(args: argparse.Namespace) -> int:
         print("operator: --publish-cilium-crds requires a kube backend",
               file=sys.stderr)
         return 2
+    if args.install_crds and not use_kube:
+        print("operator: --install-crds requires a kube backend",
+              file=sys.stderr)
+        return 2
     store = CRDStore()
     bridges = []
     sinks = []
@@ -102,6 +106,11 @@ def cmd_operator(args: argparse.Namespace) -> int:
         except (ValueError, OSError) as e:
             print(f"operator: {e}", file=sys.stderr)
             return 2
+        if args.install_crds:
+            # Self-register the retina.sh CRDs (registercrd.go analog).
+            from retina_tpu.operator.crdinstall import install_crds
+
+            install_crds(kube.client)
         bridges.append(kube)
         sinks.append(kube.patch_status)
         if args.publish_cilium_crds:
@@ -425,6 +434,8 @@ def build_parser() -> argparse.ArgumentParser:
     o.add_argument("--leader-elect", action="store_true",
                    help="coordinate replicas via a coordination.k8s.io "
                         "Lease; followers watch but do not reconcile")
+    o.add_argument("--install-crds", action="store_true",
+                   help="self-register the retina.sh CRDs at startup")
     o.add_argument("--node-name", default="local")
     o.add_argument("--poll-interval", type=float, default=2.0)
     o.set_defaults(fn=cmd_operator)

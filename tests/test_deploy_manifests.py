@@ -74,6 +74,92 @@ def test_rbac_covers_watched_resources():
     assert {"get", "create", "update"} <= lease_verbs
 
 
+def test_crds_yaml_matches_generator():
+    """deploy/manifests/crds.yaml is the rendered copy of
+    crdinstall.crd_manifests() (the operator self-installs from the
+    code, the file serves kubectl-apply flows — they must not drift)."""
+    from retina_tpu.operator.crdinstall import crd_manifests
+
+    with open(os.path.join(DEPLOY, "crds.yaml")) as fh:
+        on_disk = [d for d in yaml.safe_load_all(fh) if d]
+    assert on_disk == crd_manifests()
+
+
+def test_install_crds_create_noop_and_upgrade(tmp_path):
+    """Fresh cluster: 3 POSTs. Re-run: 409 -> GET shows current spec ->
+    no write. Upgrade (stored spec differs): 409 -> GET -> PUT with the
+    stored resourceVersion (registercrd.go apply semantics)."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from retina_tpu.operator.crdinstall import install_crds
+    from retina_tpu.operator.kubeclient import KubeClient
+
+    store: dict = {}
+    puts: list = []
+
+    class Api(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: D102
+            pass
+
+        def _body(self):
+            ln = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(ln))
+
+        def _send(self, doc, code=200):
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):  # noqa: N802
+            doc = self._body()
+            name = doc["metadata"]["name"]
+            if name in store:
+                self._send({"code": 409}, 409)
+                return
+            doc["metadata"]["resourceVersion"] = "1"
+            store[name] = doc
+            self._send(doc, 201)
+
+        def do_GET(self):  # noqa: N802
+            name = self.path.rstrip("/").split("/")[-1]
+            if name in store:
+                self._send(store[name])
+            else:
+                self._send({"code": 404}, 404)
+
+        def do_PUT(self):  # noqa: N802
+            doc = self._body()
+            name = doc["metadata"]["name"]
+            puts.append(name)
+            store[name] = doc
+            self._send(doc)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Api)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    kc = tmp_path / "kc"
+    kc.write_text(yaml.safe_dump({
+        "clusters": [{"name": "c", "cluster": {
+            "server": f"http://127.0.0.1:{httpd.server_address[1]}"}}],
+        "contexts": [], "users": [],
+    }))
+    try:
+        client = KubeClient(str(kc))
+        assert install_crds(client) == 3  # fresh: all created
+        assert install_crds(client) == 0  # current: no writes
+        assert not puts
+        # Simulate an older operator's schema on the server.
+        store["captures.retina.sh"]["spec"]["versions"][0].pop(
+            "additionalPrinterColumns")
+        assert install_crds(client) == 1  # upgraded in place
+        assert puts == ["captures.retina.sh"]
+    finally:
+        httpd.shutdown()
+
+
 def test_operator_deployment_uses_leader_election():
     deps = [d for d in load_all() if d["kind"] == "Deployment"
             and d["metadata"]["name"] == "retina-tpu-operator"]
