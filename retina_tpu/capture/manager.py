@@ -40,11 +40,14 @@ class CaptureManager:
         self._provider = provider
 
     def capture_network(self, job: CaptureJob, work_dir: str) -> str:
-        """Run the packet capture; returns the pcap path."""
+        """Run the packet capture; returns the capture-file path."""
         provider = self._provider or best_provider()
         stamp = datetime.datetime.now().strftime("%Y%m%d%H%M%S")
+        # Providers own their file format: .pcap for tcpdump/socket/
+        # replay, .etl for netsh (the path returned IS the file written).
+        suffix = getattr(provider, "suffix", ".pcap")
         pcap = os.path.join(
-            work_dir, f"{job.job_name()}-{stamp}.pcap"
+            work_dir, f"{job.job_name()}-{stamp}{suffix}"
         )
         _log.info(
             "capturing on %s: provider=%s filter=%r duration=%ds",
@@ -85,7 +88,8 @@ class CaptureManager:
             if job.include_metadata:
                 self.collect_metadata(wd)
             tarball = os.path.join(
-                wd, os.path.basename(pcap).replace(".pcap", ".tar.gz")
+                wd, os.path.splitext(os.path.basename(pcap))[0]
+                + ".tar.gz"
             )
             with tarfile.open(tarball, "w:gz") as tf:
                 tf.add(pcap, arcname=os.path.basename(pcap))

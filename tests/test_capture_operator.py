@@ -249,3 +249,153 @@ def test_operator_config_and_endpoint_reconciles():
     assert cache.get_obj_by_ip("10.0.0.1").name == "w"
     store.delete(KIND_ENDPOINT, "w")
     assert cache.get_obj_by_ip("10.0.0.1") is None
+
+
+# ------------------------------------------------------- netsh provider
+def test_netsh_filter_from_ips():
+    """crd_to_job.go:501-538 semantics: per-family address groups."""
+    from retina_tpu.capture.providers import netsh_filter_from_ips
+
+    assert netsh_filter_from_ips([]) == ""
+    assert netsh_filter_from_ips(["10.0.0.1", "10.0.0.2"]) == \
+        "IPv4.Address=(10.0.0.1,10.0.0.2)"
+    assert netsh_filter_from_ips(["10.0.0.1", "fd00::5"]) == \
+        "IPv4.Address=(10.0.0.1) IPv6.Address=(fd00::5)"
+    assert netsh_filter_from_ips(["fd00::5"]) == "IPv6.Address=(fd00::5)"
+
+
+class FakeRun:
+    def __init__(self, show_status_rc=1, fail_start=False,
+                 fail_stop=False):
+        self.calls: list[list[str]] = []
+        self.show_status_rc = show_status_rc
+        self.fail_start = fail_start
+        self.fail_stop = fail_stop
+
+    def __call__(self, args, timeout):
+        import types
+
+        self.calls.append(args)
+        rc = 0
+        if args[:4] == ["netsh", "trace", "show", "status"]:
+            rc = self.show_status_rc
+            self.show_status_rc = 1  # stale session stopped after that
+        elif "start" in args and self.fail_start:
+            rc = 1
+        elif args == ["netsh", "trace", "stop"] and self.fail_stop:
+            rc = 1
+        return types.SimpleNamespace(returncode=rc, stdout="", stderr="")
+
+
+def test_tcpdump_filter_to_netsh():
+    """The PRODUCTION filter path: the translator synthesizes tcpdump
+    syntax for every node; netsh keeps the host IPs and drops terms
+    with no netsh equivalent."""
+    from retina_tpu.capture.providers import tcpdump_filter_to_netsh
+
+    assert tcpdump_filter_to_netsh(
+        "(host 10.0.0.1 or host 10.0.0.2)"
+    ) == "IPv4.Address=(10.0.0.1,10.0.0.2)"
+    assert tcpdump_filter_to_netsh(
+        "(host 10.0.0.1 or host fd00::5) and port 80"
+    ) == "IPv4.Address=(10.0.0.1) IPv6.Address=(fd00::5)"
+    assert tcpdump_filter_to_netsh("port 80") == ""
+    assert tcpdump_filter_to_netsh("") == ""
+
+
+def test_netsh_provider_happy_path():
+    """network_capture_win.go:63-150 control flow: status check, start
+    with translated filter/maxSize argv-split, sleep, stop; the file
+    written is EXACTLY the path the manager asked for."""
+    from retina_tpu.capture.providers import NetshProvider
+
+    run = FakeRun()
+    slept = []
+    p = NetshProvider(runner=run, sleep=slept.append)
+    assert p.suffix == ".etl"
+    p.capture("/tmp/cap.etl",
+              filter_expr="(host 10.0.0.1 or host fd00::1)",
+              duration_s=7, max_size_mb=50)
+    assert slept == [7]
+    start = next(c for c in run.calls if "start" in c)
+    assert "tracefile=/tmp/cap.etl" in start
+    # Filter groups are SEPARATE argv entries, not one string.
+    assert "IPv4.Address=(10.0.0.1)" in start
+    assert "IPv6.Address=(fd00::1)" in start
+    assert "maxSize=50" in start
+    assert run.calls[-1] == ["netsh", "trace", "stop"]
+
+
+def test_netsh_provider_wraps_runner_errors():
+    """TimeoutExpired/FileNotFoundError become CaptureError, matching
+    the TcpdumpProvider contract callers rely on."""
+    import subprocess as sp
+
+    from retina_tpu.capture.providers import CaptureError, NetshProvider
+
+    def timeout_runner(args, timeout):
+        raise sp.TimeoutExpired(args, timeout)
+
+    with pytest.raises(CaptureError, match="did not terminate"):
+        NetshProvider(runner=timeout_runner,
+                      sleep=lambda s: None).capture("/t.etl",
+                                                    duration_s=1)
+
+    def missing_runner(args, timeout):
+        raise FileNotFoundError("cmd")
+
+    with pytest.raises(CaptureError, match="not available"):
+        NetshProvider(runner=missing_runner,
+                      sleep=lambda s: None).capture("/t.etl",
+                                                    duration_s=1)
+
+
+def test_capture_manager_uses_provider_suffix(tmp_path):
+    """An .etl provider's artifact lands in the tarball under its real
+    name (the manager derives the file name from provider.suffix)."""
+    from retina_tpu.capture.manager import CaptureManager
+    from retina_tpu.capture.translator import CaptureJob
+
+    class EtlProvider:
+        name = "fake-etl"
+        suffix = ".etl"
+
+        def capture(self, out_path, **kw):
+            with open(out_path, "wb") as fh:
+                fh.write(b"ETL")
+
+    job = CaptureJob(
+        capture_name="win", namespace="d", node_name="n",
+        filter_expr="", duration_s=1, max_size_mb=1,
+        packet_size_bytes=0, include_metadata=False,
+        output={"host_path": str(tmp_path)},
+    )
+    arts = CaptureManager(provider=EtlProvider()).run_job(job)
+    assert arts and arts[0].endswith(".tar.gz")
+    import tarfile
+
+    with tarfile.open(arts[0]) as tf:
+        names = tf.getnames()
+    assert any(n.endswith(".etl") for n in names), names
+
+
+def test_netsh_provider_stops_stale_session_and_raises_on_failure():
+    from retina_tpu.capture.providers import CaptureError, NetshProvider
+
+    # A running stale session (show status rc=0) is stopped first.
+    run = FakeRun(show_status_rc=0)
+    NetshProvider(runner=run, sleep=lambda s: None).capture(
+        "/tmp/x.etl", duration_s=1)
+    stops = [c for c in run.calls if c == ["netsh", "trace", "stop"]]
+    assert len(stops) == 2  # stale stop + final stop
+
+    run = FakeRun(fail_start=True)
+    with pytest.raises(CaptureError, match="start failed"):
+        NetshProvider(runner=run, sleep=lambda s: None).capture(
+            "/tmp/x.etl", duration_s=1)
+
+    # Stop failure surfaces too (the capture file may be unusable).
+    run = FakeRun(fail_stop=True)
+    with pytest.raises(CaptureError, match="stop failed"):
+        NetshProvider(runner=run, sleep=lambda s: None).capture(
+            "/tmp/x.etl", duration_s=1)
