@@ -112,15 +112,20 @@ class RegisterPods(Step):
 
     name = "register-pods"
 
-    def __init__(self, pods: dict[str, str]):
-        """pods: name -> ip."""
+    def __init__(self, pods: dict[str, str],
+                 annotations: dict[str, dict[str, str]] | None = None):
+        """pods: name -> ip; annotations: name -> {key: value} (the
+        retina.sh=observe opt-in scenarios)."""
         self.pods = pods
+        self.annotations = annotations or {}
 
     def run(self, ctx: dict[str, Any]) -> None:
         d = ctx["daemon"]
         for name, ip in self.pods.items():
+            ann = tuple(sorted(self.annotations.get(name, {}).items()))
             d.cm.cache.update_endpoint(
-                RetinaEndpoint(name=name, namespace="default", ips=(ip,))
+                RetinaEndpoint(name=name, namespace="default", ips=(ip,),
+                               annotations=ann)
             )
         # Identity reconcile is debounced; wait for the device table.
         time.sleep(0.2)
@@ -151,18 +156,39 @@ class ScrapeAssert(Step):
         labels: dict[str, str] | None = None,
         value: Callable[[float], bool] | float | None = None,
         timeout_s: float = 30.0,
+        absent: bool = False,
     ):
+        """``absent=True`` asserts the series does NOT exist — one
+        scrape, no retry; sequence it AFTER a positive assert so the
+        data path is known to have flowed."""
+        if absent and value is not None:
+            raise ValueError(
+                "ScrapeAssert: 'absent' and 'value' are mutually "
+                "exclusive — the absent branch never consults value"
+            )
         self.metric = metric
         self.labels = labels
         self.value = value
         self.timeout_s = timeout_s
-        self.name = f"scrape-assert:{metric}"
+        self.absent = absent
+        self.name = f"scrape-assert{'-absent' if absent else ''}:{metric}"
 
     def run(self, ctx: dict[str, Any]) -> None:
         checker = PrometheusChecker(
             f"http://127.0.0.1:{ctx['port']}/metrics",
             timeout_s=self.timeout_s,
         )
+        if self.absent:
+            samples = checker.scrape()
+            hits = [s for s in checker._match(samples, self.metric,
+                                              self.labels)
+                    if s.value != 0]
+            if hits:
+                raise StepFailed(
+                    f"expected NO {self.metric}{self.labels} series, "
+                    f"found {hits[:3]}"
+                )
+            return
         sample = checker.check_metric(self.metric, self.labels, self.value)
         ctx.setdefault("samples", {})[self.metric] = sample
 

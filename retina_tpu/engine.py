@@ -52,6 +52,10 @@ def pipeline_config_from(cfg: Config) -> PipelineConfig:
         enable_conntrack=cfg.enable_conntrack_metrics,
         bypass_filter=cfg.bypass_lookup_ip_of_interest
         or not cfg.enable_pod_level,
+        # Annotation opt-in: ONLY the filter map (fed by the metrics
+        # module's annotated-pod set) decides interest; identity alone
+        # must not readmit an un-annotated pod's traffic.
+        identity_implies_interest=not cfg.enable_annotations,
         # Low aggregation needs conntrack reports to drive the sketch
         # sampling; without conntrack, fall back to full per-packet feeds
         # (the reference likewise compiles DATA_AGGREGATION_LEVEL into the
@@ -231,6 +235,10 @@ class SketchEngine:
             m.anomaly_zscore.labels(dimension=dim).set(
                 float(self.last_window["zscore"][i])
             )
+            if self.last_window["anomaly"][i]:
+                # Counter survives scrape cadence: a 0.2s anomalous
+                # window must be visible at a 30s scrape.
+                m.anomaly_windows.labels(dimension=dim).inc()
 
     def start(self, stop: threading.Event) -> None:
         """Feed loop: drain sink → batch → device; close windows on time.

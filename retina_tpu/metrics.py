@@ -64,6 +64,7 @@ class Metrics:
         self.entropy_bits = g(mn.ENTROPY_BITS, [mn.L_DIMENSION])
         self.anomaly_flag = g(mn.ANOMALY_FLAG, [mn.L_DIMENSION])
         self.anomaly_zscore = g(mn.ANOMALY_ZSCORE, [mn.L_DIMENSION])
+        self.anomaly_windows = c(mn.ANOMALY_WINDOWS, [mn.L_DIMENSION])
 
         # control-plane self metrics (metrics.go:100-120)
         self.plugin_reconcile_failures = c(

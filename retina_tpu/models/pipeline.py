@@ -99,6 +99,13 @@ class PipelineConfig:
     # an entry in the explicit filter map are masked out of every
     # aggregator. bypass_filter=True admits everything.
     bypass_filter: bool = True
+    # Whether resolving to a pod identity alone makes an event
+    # interesting. True matches the default deployment (the metrics
+    # module tracks every pod, so the filter map holds every pod IP
+    # anyway). False = annotation opt-in mode: ONLY the filter map
+    # decides (retina_filter.c semantics) — an un-annotated pod's
+    # identity must not readmit its traffic.
+    identity_implies_interest: bool = True
     # DataAggregationLevel (reference config.go:16-23, compiled into the
     # datapath via dynamic.h and consumed at packetparser.c:214-225): at
     # "low", the packet-stream sketches (flow_hh, svc_hh, hll_flows,
@@ -251,7 +258,10 @@ class TelemetryPipeline:
 
         # ---- IPs-of-interest filter (retina_filter.c lookup() analog) ----
         if not c.bypass_filter:
-            interest = (src_pod > 0) | (dst_pod > 0)
+            if c.identity_implies_interest:
+                interest = (src_pod > 0) | (dst_pod > 0)
+            else:
+                interest = jnp.zeros((b,), bool)
             if filter_map is not None:
                 interest |= (filter_map.lookup(src_ip) > 0) | (
                     filter_map.lookup(dst_ip) > 0
@@ -508,9 +518,14 @@ class TelemetryPipeline:
         self, state: PipelineState, z_thresh: float = 4.0
     ) -> tuple[PipelineState, dict[str, jnp.ndarray]]:
         """Close an entropy window: compute entropies, update the anomaly
-        EWMA, reset the window histograms. Called once per window (1s)."""
+        EWMA, reset the window histograms. Called once per window (1s).
+        Idle windows (no traffic) do not touch the baseline — see
+        AnomalyEWMA.observe."""
         h = state.entropy.entropy_bits()
-        anomaly, flags, z = state.anomaly.observe(h, z_thresh=z_thresh)
+        active = state.entropy.counts.sum(axis=-1) > 0
+        anomaly, flags, z = state.anomaly.observe(
+            h, z_thresh=z_thresh, active=active
+        )
         new = dataclasses.replace(
             state, entropy=state.entropy.reset(), anomaly=anomaly
         )

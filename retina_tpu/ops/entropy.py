@@ -108,25 +108,43 @@ class AnomalyEWMA:
         return cls(mean=z(), var=z(), n_obs=z(), alpha=alpha)
 
     def observe(
-        self, h: jnp.ndarray, z_thresh: float = 4.0, min_windows: int = 10
+        self,
+        h: jnp.ndarray,
+        z_thresh: float = 4.0,
+        min_windows: int = 10,
+        active: jnp.ndarray | bool = True,
     ) -> tuple["AnomalyEWMA", jnp.ndarray, jnp.ndarray]:
-        """Returns (new_state, anomaly_flags (G,) bool, z_scores (G,))."""
+        """Returns (new_state, anomaly_flags (G,) bool, z_scores (G,)).
+
+        ``active`` (scalar or (G,) bool) marks windows that actually saw
+        traffic. Idle windows are SKIPPED entirely — no flag, no
+        baseline update, no warmup credit: an agent idling on a quiet
+        node must not train a zero-entropy baseline that (a) flags the
+        first real traffic as an attack and (b) makes a genuine
+        single-source flood look normal."""
+        active = jnp.broadcast_to(jnp.asarray(active, bool), h.shape)
         warm = self.n_obs >= min_windows
         std = jnp.sqrt(jnp.maximum(self.var, 1e-12))
-        z = jnp.where(warm, (h - self.mean) / jnp.maximum(std, 1e-3), 0.0)
-        flag = warm & (jnp.abs(z) > z_thresh)
+        z = jnp.where(
+            warm & active, (h - self.mean) / jnp.maximum(std, 1e-3), 0.0
+        )
+        flag = warm & active & (jnp.abs(z) > z_thresh)
         # Do not absorb anomalous windows into the baseline (else a sustained
         # attack trains the detector to call it normal). First observation
         # seeds the mean outright — otherwise the zero-start transient
         # pollutes the variance for tens of windows.
         first = self.n_obs == 0
-        a = jnp.where(flag, 0.0, jnp.where(first, 1.0, self.alpha))
+        a = jnp.where(
+            flag | ~active, 0.0, jnp.where(first, 1.0, self.alpha)
+        )
         delta = h - self.mean
         new_mean = self.mean + a * delta
-        new_var = jnp.where(first, 0.0, (1 - a) * (self.var + a * delta * delta))
+        new_var = jnp.where(first & active, 0.0,
+                            (1 - a) * (self.var + a * delta * delta))
         return (
             dataclasses.replace(
-                self, mean=new_mean, var=new_var, n_obs=self.n_obs + 1
+                self, mean=new_mean, var=new_var,
+                n_obs=self.n_obs + active.astype(self.n_obs.dtype),
             ),
             flag,
             z,

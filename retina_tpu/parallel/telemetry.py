@@ -150,7 +150,13 @@ class ShardedTelemetry:
                 s.entropy, counts=jax.lax.psum(s.entropy.counts, self.axes)
             )
             h = merged_ent.entropy_bits()
-            anomaly, flags, z = s.anomaly.observe(h, z_thresh=z_thresh)
+            # Idle windows (including the engine's compile() warm-up)
+            # must not seed/poison the EWMA baseline — same contract as
+            # the single-chip end_window (models/pipeline.py).
+            active = merged_ent.counts.sum(axis=-1) > 0
+            anomaly, flags, z = s.anomaly.observe(
+                h, z_thresh=z_thresh, active=active
+            )
             new = dataclasses.replace(
                 s, entropy=s.entropy.reset(), anomaly=anomaly
             )

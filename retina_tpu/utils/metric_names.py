@@ -52,6 +52,10 @@ DISTINCT_SRC_PER_POD = SKETCH_PREFIX + "distinct_sources_per_pod"
 ENTROPY_BITS = SKETCH_PREFIX + "entropy_bits"
 ANOMALY_FLAG = SKETCH_PREFIX + "anomaly_flag"
 ANOMALY_ZSCORE = SKETCH_PREFIX + "anomaly_zscore"
+# Monotonic count of anomalous windows: the flag gauge only shows
+# the CURRENT window, which a 10-30s scrape cadence would miss for
+# sub-second windows.
+ANOMALY_WINDOWS = SKETCH_PREFIX + "anomaly_windows_total"
 
 # Control-plane self metrics (reference pkg/metrics/metrics.go:14-120).
 PLUGIN_RECONCILE_FAILURES = PREFIX + "plugin_manager_failed_to_reconcile"
