@@ -54,6 +54,7 @@ class Metrics:
             mn.DNS_RESPONSE_COUNT, [mn.L_QTYPE, mn.L_RCODE]
         )
         self.conntrack_packets = g(mn.CONNTRACK_PACKETS, [mn.L_DIRECTION])
+        self.active_connections = g(mn.ACTIVE_CONNECTIONS, [])
         self.conntrack_bytes = g(mn.CONNTRACK_BYTES, [mn.L_DIRECTION])
 
         # sketch-derived node-level series

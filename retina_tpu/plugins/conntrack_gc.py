@@ -48,6 +48,7 @@ class ConntrackPlugin(Plugin):
             m.conntrack_bytes.labels(direction="total").set(
                 stats.get("bytes", 0)
             )
+            m.active_connections.set(stats.get("active", 0))
         return stats
 
     def start(self, stop: threading.Event) -> None:

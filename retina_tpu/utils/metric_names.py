@@ -56,6 +56,7 @@ ANOMALY_ZSCORE = SKETCH_PREFIX + "anomaly_zscore"
 # the CURRENT window, which a 10-30s scrape cadence would miss for
 # sub-second windows.
 ANOMALY_WINDOWS = SKETCH_PREFIX + "anomaly_windows_total"
+ACTIVE_CONNECTIONS = PREFIX + "conntrack_active_connections"
 
 # Control-plane self metrics (reference pkg/metrics/metrics.go:14-120).
 PLUGIN_RECONCILE_FAILURES = PREFIX + "plugin_manager_failed_to_reconcile"

@@ -171,3 +171,63 @@ def test_operator_deployment_uses_leader_election():
         # File-backend captures would re-run per failover (per-pod
         # status); multi-replica must not use --watch-dir.
         assert "--watch-dir" not in args
+
+
+def test_grafana_dashboards_reference_real_metrics():
+    """Every networkobservability_* series a dashboard queries must
+    exist in the REAL exposition output (ground truth: a Metrics +
+    default metrics-module reconcile, gathered through the exporter) —
+    this catches gauges queried as histograms and counters queried
+    without their _total suffix, not just renames."""
+    import re
+
+    from retina_tpu.crd.types import MetricsConfiguration
+    from retina_tpu.exporter import Exporter
+    from retina_tpu.exporter import reset_for_tests as reset_exporter
+    from retina_tpu.metrics import initialize_metrics
+    from retina_tpu.metrics import reset_for_tests as reset_metrics
+    from retina_tpu.module.metric_objects import METRIC_CONSTRUCTORS
+
+    reset_exporter()
+    reset_metrics()
+    try:
+        ex = Exporter()
+        initialize_metrics(ex)
+        # Advanced families exist only after a reconcile; construct all.
+        conf = MetricsConfiguration.default()
+        for co in conf.spec.context_options:
+            ctor = METRIC_CONSTRUCTORS.get(co.metric_name)
+            if ctor:
+                ctor(co, ex)
+        # Derive every queryable sample name from the registries'
+        # metric families WITH their types: labeled-but-unobserved
+        # metrics emit no sample lines, so text parsing would miss them.
+        exposed = set()
+        for reg in (ex.default_registry, ex.advanced_registry):
+            for fam in reg.collect():
+                if fam.type == "counter":
+                    exposed.add(fam.name + "_total")
+                elif fam.type == "histogram":
+                    exposed.update({fam.name + s
+                                    for s in ("_bucket", "_sum",
+                                              "_count")})
+                else:
+                    exposed.add(fam.name)
+        dash_dir = os.path.join(DEPLOY, "..", "grafana-dashboards")
+        boards = sorted(glob.glob(os.path.join(dash_dir, "*.json")))
+        assert len(boards) >= 4  # sketches + pod-level + dns + cluster
+        unknown = {}
+        for path in boards:
+            text = open(path).read()
+            for name in set(re.findall(
+                    r"networkobservability_[a-z0-9_]+", text)):
+                if name not in exposed:
+                    unknown.setdefault(os.path.basename(path),
+                                       []).append(name)
+        assert not unknown, (
+            f"dashboards query series absent from the exposition: "
+            f"{unknown}"
+        )
+    finally:
+        reset_exporter()
+        reset_metrics()
