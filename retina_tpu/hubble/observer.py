@@ -37,10 +37,19 @@ class FlowObserver:
 
     # -- writer side (monitoragent consumer) ---------------------------
     def consume(self, records: np.ndarray) -> None:
-        self.consume_flows([
-            record_to_flow(rec, self.cache, self.dns_resolver)
-            for rec in records
-        ])
+        """Write raw record rows; decode is LAZY (on read).
+
+        The writer sits on the hot mirror path (every flow the engine
+        sees), while readers are few and slow (gRPC streams). Eager
+        per-record dict decode capped the writer at ~0.15M flows/s;
+        storing (block, row) refs moves the ~µs decode to the reader,
+        which only ever materializes the ≤capacity flows it serves."""
+        with self._lock:
+            for i in range(len(records)):
+                self._ring[self._seq & (self._cap - 1)] = (records, i)
+                self._seq += 1
+            self.flows_seen = self._seq
+            self._lock.notify_all()
 
     def consume_flows(self, flows: list[dict]) -> None:
         """Write already-decoded flow dicts (relay peer ingestion)."""
@@ -51,6 +60,28 @@ class FlowObserver:
             self.flows_seen = self._seq
             self._lock.notify_all()
 
+    # -- lazy decode ----------------------------------------------------
+    def _materialize(self, entry, seq: Optional[int] = None) -> dict:
+        """Decode a raw ring entry to a flow dict, memoizing the result
+        back into the ring slot (decode once, however many readers).
+
+        Semantics note: identity/DNS enrichment happens at FIRST READ,
+        not at arrival — if a pod IP is recycled while a flow sits
+        unread in the ring, the flow gets the current owner's identity.
+        The skew window is bounded by ring residency (capacity flows,
+        well under a second at production rates); upstream Hubble has
+        the same property between its own ring and its ipcache."""
+        if isinstance(entry, tuple):  # (records_block, row_index)
+            block, i = entry
+            f = record_to_flow(block[i], self.cache, self.dns_resolver)
+            if seq is not None:
+                with self._lock:
+                    slot = seq & (self._cap - 1)
+                    if self._ring[slot] is entry:
+                        self._ring[slot] = f
+            return f
+        return entry
+
     # -- reader side ---------------------------------------------------
     def snapshot_flows(self) -> tuple[list[dict], int]:
         """All currently-buffered flows (oldest first) + the sequence
@@ -60,11 +91,13 @@ class FlowObserver:
         with self._lock:
             end = self._seq
             window = min(end, self._cap)
-            flows = [
-                self._ring[i & (self._cap - 1)]
+            entries = [
+                (i, self._ring[i & (self._cap - 1)])
                 for i in range(end - window, end)
             ]
-        return [f for f in flows if f is not None], end
+        # Materialize OUTSIDE the lock: decode must never stall writers.
+        return [self._materialize(e, seq) for seq, e in entries
+                if e is not None], end
 
     def follow_from(
         self,
@@ -85,15 +118,15 @@ class FlowObserver:
                     cursor = floor
                 while cursor < self._seq:
                     f = self._ring[cursor & (self._cap - 1)]
-                    cursor += 1
                     if f is not None:
-                        batch.append(f)
+                        batch.append((cursor, f))
+                    cursor += 1
                 if not batch and not lost:
                     self._lock.wait(timeout=0.2)
             if lost:
                 yield ("lost", lost)
-            for f in batch:
-                yield ("flow", f)
+            for seq, f in batch:
+                yield ("flow", self._materialize(f, seq))
 
     def get_flows(
         self,
@@ -122,12 +155,13 @@ class FlowObserver:
                 batch = []
                 while cursor < limit:
                     f = self._ring[cursor & (self._cap - 1)]
-                    cursor += 1
                     if f is not None:
-                        batch.append(f)
+                        batch.append((cursor, f))
+                    cursor += 1
                 if not batch and follow:
                     self._lock.wait(timeout=0.2)
-            for f in batch:
+            for seq, f in batch:
+                f = self._materialize(f, seq)
                 if filter is None or filter.matches(f):
                     yield f
             if not follow and cursor >= end0:

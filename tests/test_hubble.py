@@ -187,3 +187,31 @@ def test_hubble_grpc_end_to_end():
         client.close()
     finally:
         srv.stop()
+
+
+def test_observer_lazy_decode_memoizes():
+    """The writer stores raw rows (hot path ~9M flows/s); the FIRST read
+    decodes and memoizes into the ring, so N readers decode once."""
+    import numpy as np
+
+    from retina_tpu.events.schema import EventBuilder
+    from retina_tpu.hubble.observer import FlowObserver
+
+    b = EventBuilder(8)
+    for i in range(8):
+        b.add(src_ip=0x0A000000 + i, dst_ip=0x0A0000FF,
+              src_port=1000 + i, dst_port=80, bytes_=100)
+    rec = b._batch.valid_rows()
+    obs = FlowObserver(capacity=16)
+    obs.consume(rec)
+    # Raw tuples in the ring before any read.
+    assert any(isinstance(e, tuple) for e in obs._ring if e is not None)
+    flows, _ = obs.snapshot_flows()
+    assert len(flows) == 8
+    assert flows[0]["ip"]["source"] == "10.0.0.0"
+    # Memoized: ring now holds decoded dicts, not tuples.
+    assert all(not isinstance(e, tuple)
+               for e in obs._ring if e is not None)
+    # Second read returns identical objects (no re-decode).
+    flows2, _ = obs.snapshot_flows()
+    assert flows2[0] is flows[0]
