@@ -293,11 +293,10 @@ class SketchEngine:
                 return self._snap_cache
         with self._state_lock:
             dev_snap = self.sharded.snapshot(self.state, int(time.time()))
-        host = {
-            k: (np.asarray(v) if not isinstance(v, dict)
-                else {kk: np.asarray(vv) for kk, vv in v.items()})
-            for k, v in dev_snap.items()
-        }
+        # ONE batched device→host transfer for the whole tree: per-leaf
+        # np.asarray would pay a blocking tunnel round-trip per array
+        # (measured >2s at production shapes vs the <1s scrape budget).
+        host = jax.device_get(dev_snap)
         host["steps"] = self._steps
         host["events_in"] = self._events_in
         with self._snap_lock:

@@ -55,6 +55,14 @@ class Metrics:
         )
         self.conntrack_packets = g(mn.CONNTRACK_PACKETS, [mn.L_DIRECTION])
         self.active_connections = g(mn.ACTIVE_CONNECTIONS, [])
+        # Declared for external connectivity probers to set, exactly as
+        # the reference declares them unconsumed (metrics.go:49-60).
+        self.node_connectivity_status = g(
+            mn.NODE_CONNECTIVITY_STATUS, ["source_node", "target_node"]
+        )
+        self.node_connectivity_latency = g(
+            mn.NODE_CONNECTIVITY_LATENCY, ["source_node", "target_node"]
+        )
         self.conntrack_bytes = g(mn.CONNTRACK_BYTES, [mn.L_DIRECTION])
 
         # sketch-derived node-level series
