@@ -242,3 +242,30 @@ class TestShardedMatchesSingle:
         assert int(np.asarray(snap["totals"])[6]) == int(
             np.asarray(s_state.totals)[6]
         )
+
+
+def test_partition_single_device_fast_path():
+    """D=1 takes the no-hash fast path: a full contiguous batch is a
+    zero-copy view (documented aliasing contract); partial batches pad
+    with a fresh array; overflow still drops-and-counts."""
+    rng = np.random.default_rng(11)
+    cap = 256
+    full = rng.integers(0, 2**31, size=(cap, NUM_FIELDS),
+                        dtype=np.int64).astype(np.uint32)
+    sb = partition_events(full, 1, cap)
+    assert sb.records.shape == (1, cap, NUM_FIELDS)
+    assert int(sb.n_valid[0]) == cap and sb.lost == 0
+    np.testing.assert_array_equal(sb.records[0], full)
+    # Zero-copy: the view shares the caller's buffer.
+    assert np.shares_memory(sb.records, full)
+
+    partial = full[:100]
+    sb = partition_events(partial, 1, cap)
+    assert int(sb.n_valid[0]) == 100 and sb.lost == 0
+    np.testing.assert_array_equal(sb.records[0, :100], partial)
+    assert not np.shares_memory(sb.records, full)  # padded copy
+
+    over = rng.integers(0, 2**31, size=(cap + 40, NUM_FIELDS),
+                        dtype=np.int64).astype(np.uint32)
+    sb = partition_events(over, 1, cap)
+    assert int(sb.n_valid[0]) == cap and sb.lost == 40
