@@ -266,7 +266,10 @@ class SketchEngine:
                 n_pending >= cap or now - last_flush >= self.cfg.flush_interval_s
             )
             if flush_due:
-                all_rec = np.concatenate(pending, axis=0)
+                if len(pending) == 1:
+                    all_rec = pending[0]  # skip the concat copy
+                else:
+                    all_rec = np.concatenate(pending, axis=0)
                 pending.clear()
                 n_pending = 0
                 last_flush = now
