@@ -157,10 +157,30 @@ def cmd_operator(args: argparse.Namespace) -> int:
             kube.client,
             namespace=args.namespace or "kube-system",
         )
+    job_runner = None
+    cluster_nodes = None
+    if use_kube:
+        # Remote capture nodes get batch/v1 Jobs (capture
+        # controller.go:102); local nodes still run in-process. A node
+        # watcher supplies the live cluster inventory for translation.
+        from retina_tpu.capture.k8s_jobs import KubeJobRunner
+        from retina_tpu.controllers.cache import Cache
+        from retina_tpu.operator.kubewatch import CoreWatcher
+
+        job_runner = KubeJobRunner(kube.client,
+                                   image=args.capture_image)
+        node_cache = Cache()
+        bridges.append(CoreWatcher(
+            node_cache, args.kubeconfig, include_pods=False,
+            include_services=False, include_nodes=True,
+        ))
+        cluster_nodes = node_cache.list_nodes
     op = Operator(
         store, node_name=args.node_name,
         status_sink=fan_out_status if sinks else None,
         leading=(elector.is_leader if elector else None),
+        job_runner=job_runner,
+        cluster_nodes=cluster_nodes,
     )
     if elector is not None:
         elector.on_started_leading = op.resync
@@ -200,7 +220,9 @@ def cmd_capture_create(args: argparse.Namespace) -> int:
             output=CaptureOutput(host_path=args.host_path),
             duration_s=args.duration,
             max_capture_size_mb=args.max_size,
+            packet_size_bytes=args.packet_size,
             tcpdump_filter=args.filter,
+            include_metadata=not args.no_metadata,
         ),
     )
     nodes = [RetinaNode(name=n) for n in (args.node_names or ["local"])]
@@ -436,6 +458,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "Lease; followers watch but do not reconcile")
     o.add_argument("--install-crds", action="store_true",
                    help="self-register the retina.sh CRDs at startup")
+    o.add_argument("--capture-image", default="retina-tpu:latest",
+                   help="image for remote capture Jobs (kube backend)")
     o.add_argument("--node-name", default="local")
     o.add_argument("--poll-interval", type=float, default=2.0)
     o.set_defaults(fn=cmd_operator)
@@ -450,6 +474,10 @@ def build_parser() -> argparse.ArgumentParser:
     cc.add_argument("--duration", type=int, default=10)
     cc.add_argument("--max-size", type=int, default=100)
     cc.add_argument("--filter", default="")
+    cc.add_argument("--packet-size", type=int, default=0,
+                    help="snap length in bytes (0 = full packets)")
+    cc.add_argument("--no-metadata", action="store_true",
+                    help="skip the network-state metadata dumps")
     cc.set_defaults(fn=cmd_capture_create)
     cl = csub.add_parser("list")
     cl.add_argument("--host-path", required=True)

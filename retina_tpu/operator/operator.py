@@ -17,6 +17,7 @@ Reference analogs:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Optional
 
 from retina_tpu.capture.manager import CaptureManager
@@ -49,6 +50,8 @@ class Operator:
         capture_manager: Optional[CaptureManager] = None,
         status_sink: Optional[Any] = None,
         leading: Optional[Any] = None,
+        job_runner: Optional[Any] = None,
+        cluster_nodes: Optional[Any] = None,
     ):
         """``status_sink(kind, obj)`` is called when an object's status
         settles — the kube backend passes KubeBridge.patch_status so
@@ -70,6 +73,16 @@ class Operator:
         self.capture_manager = capture_manager or CaptureManager()
         self.status_sink = status_sink
         self.leading = leading or (lambda: True)
+        # Remote execution (capture controller.go:102 creates batch/v1
+        # Jobs per node): non-local CaptureJobs go through this runner
+        # when present; without it they are skipped as before.
+        self.job_runner = job_runner
+        # Live cluster node inventory for capture translation (the kube
+        # backend wires a node watcher); falls back to the static list.
+        self.cluster_nodes = cluster_nodes
+        # Bounded not-yet-synced deferrals per capture key.
+        self._defers: dict[str, int] = {}
+        self.max_defers = 24  # x5s = 2 min of inventory warm-up
         self._jobs: dict[str, threading.Thread] = {}
         self._jobs_lock = threading.Lock()
 
@@ -101,17 +114,69 @@ class Operator:
                 with self._jobs_lock:
                     mine = self._jobs.get(key)
                 if mine is None or not mine.is_alive():
-                    cap.status.phase = "Failed"
-                    cap.status.jobs_failed += cap.status.jobs_active
-                    cap.status.jobs_active = 0
-                    cap.status.message = (
-                        "orphaned by leader failover; re-apply to retry"
-                    )
-                    self._log.warning("capture %s orphaned by failover",
-                                      cap.name)
-                    self._sync_status(KIND_CAPTURE, cap)
+                    self._handle_orphan(cap)
                 continue
             self._on_capture("applied", cap)
+
+    def _handle_orphan(self, cap: Capture) -> None:
+        """A Running capture with no live local thread: the old leader
+        died. Its LOCAL jobs died with it, but any remote batch/v1 Jobs
+        are still running on the cluster — adopt those instead of
+        failing them (they'd otherwise complete invisibly)."""
+
+        def settle(completed: int, failed: int,
+                   artifacts: list[str], msg: str) -> None:
+            cap.status.jobs_completed += completed
+            cap.status.jobs_failed += failed
+            cap.status.jobs_active = 0
+            cap.status.artifacts.extend(artifacts)
+            cap.status.message = msg
+            cap.status.phase = (
+                "Failed" if failed or not completed else "Completed"
+            )
+            self._sync_status(KIND_CAPTURE, cap)
+
+        if self.job_runner is None:
+            settle(0, cap.status.jobs_active, [],
+                   "orphaned by leader failover; re-apply to retry")
+            self._log.warning("capture %s orphaned by failover", cap.name)
+            return
+
+        orphaned = cap.status.jobs_active
+
+        def adopt() -> None:
+            res = self.job_runner.adopt(cap.name, cap.namespace)
+            if res is None:
+                settle(0, orphaned, [],
+                       "orphaned by leader failover; re-apply to retry")
+                return
+            completed, failed, artifacts = res
+            # The dead leader's LOCAL jobs have no batch/v1 Job to
+            # adopt — whatever the adoption didn't account for was lost
+            # with that process and counts as failed.
+            lost = max(0, orphaned - completed - failed)
+            self._log.info(
+                "capture %s: adopted %d job(s) from dead leader "
+                "(%d failed, %d lost local)", cap.name,
+                completed + failed, failed, lost,
+            )
+            settle(completed, failed + lost, artifacts,
+                   "adopted from failed-over leader"
+                   + (f"; {lost} local job(s) lost with it" if lost
+                      else ""))
+
+        # Registered under the capture key like a normal job thread so a
+        # leadership flap cannot start a second adoption (double
+        # counting); _on_capture's dedupe and this share _jobs.
+        t = threading.Thread(target=adopt, daemon=True,
+                             name=f"adopt-{cap.name}")
+        key = f"{cap.namespace}/{cap.name}"
+        with self._jobs_lock:
+            prev = self._jobs.get(key)
+            if prev is not None and prev.is_alive():
+                return  # adoption (or a real run) already in flight
+            self._jobs[key] = t
+        t.start()
 
     def _on_capture(self, event: str, cap: Capture) -> None:
         if event != "applied" or cap.status.phase not in ("Pending",):
@@ -125,25 +190,70 @@ class Operator:
             prev = self._jobs.get(key)
             if prev is not None and prev.is_alive():
                 return
+        def defer(reason: str) -> bool:
+            """Bounded retry while the node watcher warms up; returns
+            False when the budget is spent (caller then Fails)."""
+            n = self._defers.get(key, 0)
+            if n >= self.max_defers:
+                return False
+            self._defers[key] = n + 1
+            self._log.info("capture %s deferred (%d/%d): %s", cap.name,
+                           n + 1, self.max_defers, reason)
+            t = threading.Timer(
+                5.0, lambda: self._on_capture("applied", cap))
+            t.daemon = True
+            t.start()
+            return True
+
         try:
             pods = (
                 [ep for ep in self.cache.index_label_map().values()]
                 if self.cache else []
             )
-            jobs = translate_capture_to_jobs(cap, self.nodes, pods)
+            if self.cluster_nodes is not None:
+                inventory = self.cluster_nodes()
+                if not inventory:
+                    # Node watcher not synced yet (operator just booted
+                    # and the kube bridge replayed captures first).
+                    if defer("node inventory empty"):
+                        return
+                    inventory = self.nodes  # spent: fail loudly below
+            else:
+                inventory = self.nodes
+            jobs = translate_capture_to_jobs(cap, inventory, pods)
         except ValidationError as e:
+            if ("unknown nodes" in str(e)
+                    and self.cluster_nodes is not None
+                    and defer(f"inventory may be partial: {e}")):
+                # A mid-LIST inventory can be non-empty but incomplete;
+                # real unknown nodes still Fail once the budget is spent.
+                return
             cap.status.phase = "Failed"
             cap.status.message = str(e)
             self._log.warning("capture %s rejected: %s", cap.name, e)
             self._sync_status(KIND_CAPTURE, cap)
             return
-        local = [j for j in jobs if j.node_name in
-                 {n.name for n in self.nodes}]
+        self._defers.pop(key, None)
+        # With a job runner, only THIS process's node runs in-process —
+        # every other node gets a batch/v1 Job. Without one, self.nodes
+        # is "nodes this process represents" (single-process mode).
+        our_nodes = (
+            {self.node_name} if self.job_runner is not None
+            else {n.name for n in self.nodes}
+        )
+        local = [j for j in jobs if j.node_name in our_nodes]
+        # Remote nodes get batch/v1 Jobs through the runner
+        # (controller.go:102); without a runner they are skipped, as in
+        # the single-process deployments.
+        remote = (
+            [j for j in jobs if j.node_name not in our_nodes]
+            if self.job_runner is not None else []
+        )
         cap.status.phase = "Running"
-        cap.status.jobs_active = len(local)
+        cap.status.jobs_active = len(local) + len(remote)
         self._log.info(
-            "capture %s: %d job(s) (%d local)", cap.name, len(jobs),
-            len(local),
+            "capture %s: %d job(s) (%d local, %d remote)", cap.name,
+            len(jobs), len(local), len(remote),
         )
         # Publish Running immediately so backends see the in-flight phase
         # (and a watch echo of this write is a no-op, not a re-trigger).
@@ -151,18 +261,42 @@ class Operator:
 
         def run_all() -> None:
             failed = 0
-            for job in local:
+
+            def account(fn, job) -> None:
+                nonlocal failed
                 try:
-                    artifacts = self.capture_manager.run_job(job)
-                    cap.status.artifacts.extend(artifacts)
+                    cap.status.artifacts.extend(fn(job))
                     cap.status.jobs_completed += 1
-                except Exception as e:
+                except Exception as e:  # noqa: BLE001
                     self._log.exception("capture job %s failed",
                                         job.job_name())
                     failed += 1
                     cap.status.jobs_failed += 1
                     cap.status.message = str(e)
                 cap.status.jobs_active -= 1
+
+            # Create EVERY remote Job first so the per-node capture
+            # windows overlap (controller.go creates all Jobs in one
+            # reconcile), then run local capture, then wait the remotes.
+            # The run id scopes a future failover adoption to THIS
+            # generation of Jobs.
+            run_id = f"{int(time.time()):x}"
+            created: list[tuple[str, Any]] = []
+            for job in remote:
+                try:
+                    created.append(
+                        (self.job_runner.create(job, run_id=run_id), job))
+                except Exception as e:  # noqa: BLE001
+                    self._log.exception("capture job create failed: %s",
+                                        job.job_name())
+                    failed += 1
+                    cap.status.jobs_failed += 1
+                    cap.status.message = str(e)
+                    cap.status.jobs_active -= 1
+            for job in local:
+                account(self.capture_manager.run_job, job)
+            for name, job in created:
+                account(lambda j, n=name: self.job_runner.wait(n, j), job)
             cap.status.phase = "Failed" if failed else "Completed"
             self._sync_status(KIND_CAPTURE, cap)
 

@@ -1,0 +1,285 @@
+"""Remote capture via batch/v1 Jobs (capture controller.go:102-142):
+manifest shape, runner create+poll semantics, and the operator fanning a
+multi-node capture into local execution + remote Jobs."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import yaml
+
+from retina_tpu.capture.k8s_jobs import KubeJobRunner, job_manifest
+from retina_tpu.capture.manager import CaptureManager
+from retina_tpu.capture.providers import ReplayProvider
+from retina_tpu.capture.translator import CaptureJob
+from retina_tpu.common import RetinaNode
+from retina_tpu.crd.types import Capture
+from retina_tpu.operator import CRDStore, Operator
+from retina_tpu.operator.kubeclient import KubeClient
+
+from test_capture_operator import make_source
+
+
+def mk_job(node="remote-1", host_path="/var/cap"):
+    return CaptureJob(
+        capture_name="grab", namespace="default", node_name=node,
+        filter_expr="(host 10.0.0.1)", duration_s=3, max_size_mb=50,
+        packet_size_bytes=0, include_metadata=True,
+        output={"host_path": host_path},
+    )
+
+
+def test_job_manifest_shape():
+    """initJobTemplate analog: node pin, host network, caps, backoff 0,
+    hostPath output mount, the capture-create workload command."""
+    doc = job_manifest(mk_job(), image="retina-tpu:v9")
+    assert doc["kind"] == "Job"
+    assert doc["spec"]["backoffLimit"] == 0
+    pod = doc["spec"]["template"]["spec"]
+    assert pod["nodeName"] == "remote-1"
+    assert pod["hostNetwork"] is True
+    assert pod["restartPolicy"] == "Never"
+    c = pod["containers"][0]
+    assert c["image"] == "retina-tpu:v9"
+    assert c["securityContext"]["capabilities"]["add"] == [
+        "NET_ADMIN", "SYS_ADMIN"]
+    assert "--filter" in c["args"] and "(host 10.0.0.1)" in c["args"]
+    assert "--host-path" in c["args"] and "/var/cap" in c["args"]
+    assert pod["volumes"][0]["hostPath"]["path"] == "/var/cap"
+    assert c["volumeMounts"][0]["mountPath"] == "/var/cap"
+    assert doc["metadata"]["labels"]["retina.sh/capture"] == "grab"
+    assert len(doc["metadata"]["name"]) <= 63
+
+
+class FakeBatchApi(BaseHTTPRequestHandler):
+    jobs: dict = {}
+    succeed_after: int = 1  # GETs before reporting success
+    fail: bool = False
+    gets: int = 0
+
+    def log_message(self, *a):  # noqa: D102
+        pass
+
+    def _send(self, doc, code=200):
+        body = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802
+        ln = int(self.headers.get("Content-Length", 0))
+        doc = json.loads(self.rfile.read(ln))
+        FakeBatchApi.jobs[doc["metadata"]["name"]] = doc
+        self._send(doc, 201)
+
+    def do_GET(self):  # noqa: N802
+        path = self.path.split("?")[0]
+        if "watch=true" in self.path:
+            self.send_response(200)
+            self.end_headers()
+            time.sleep(0.3)
+            return
+        def with_status(doc):
+            doc = dict(doc)
+            if FakeBatchApi.fail:
+                doc["status"] = {"failed": 1}
+            elif FakeBatchApi.gets >= FakeBatchApi.succeed_after:
+                doc["status"] = {"succeeded": 1}
+            else:
+                doc["status"] = {"active": 1}
+            return doc
+
+        name = path.rstrip("/").split("/")[-1]
+        if "/jobs/" in path and name in FakeBatchApi.jobs:
+            FakeBatchApi.gets += 1
+            self._send(with_status(FakeBatchApi.jobs[name]))
+            return
+        if path.endswith("/jobs") and "labelSelector" in self.path:
+            # Adoption LIST: serve every stored job with its status.
+            self._send({
+                "items": [with_status(d)
+                          for d in FakeBatchApi.jobs.values()],
+                "metadata": {"resourceVersion": "1"},
+            })
+            return
+        self._send({"items": [], "metadata": {"resourceVersion": "1"}})
+
+
+@pytest.fixture()
+def batch_apiserver(tmp_path):
+    FakeBatchApi.jobs = {}
+    FakeBatchApi.gets = 0
+    FakeBatchApi.succeed_after = 2
+    FakeBatchApi.fail = False
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), FakeBatchApi)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    kc = tmp_path / "kc"
+    kc.write_text(yaml.safe_dump({
+        "clusters": [{"name": "c", "cluster": {
+            "server": f"http://127.0.0.1:{httpd.server_address[1]}"}}],
+        "contexts": [], "users": [],
+    }))
+    yield str(kc)
+    httpd.shutdown()
+
+
+def test_runner_creates_and_polls_to_success(batch_apiserver):
+    runner = KubeJobRunner(KubeClient(batch_apiserver), poll_s=0.1)
+    arts = runner.run_job(mk_job())
+    assert arts == ["node://remote-1/var/cap"]
+    assert len(FakeBatchApi.jobs) == 1
+    name, doc = next(iter(FakeBatchApi.jobs.items()))
+    assert doc["spec"]["template"]["spec"]["nodeName"] == "remote-1"
+
+
+def test_runner_raises_on_job_failure(batch_apiserver):
+    FakeBatchApi.fail = True
+    runner = KubeJobRunner(KubeClient(batch_apiserver), poll_s=0.1)
+    with pytest.raises(RuntimeError, match="failed on remote-1"):
+        runner.run_job(mk_job())
+
+
+def test_operator_fans_out_local_and_remote(batch_apiserver):
+    """A capture targeting a local + a remote node runs BOTH: the local
+    one through the CaptureManager, the remote through a k8s Job, with
+    combined status accounting (controller.go:142)."""
+    store = CRDStore()
+    runner = KubeJobRunner(KubeClient(batch_apiserver), poll_s=0.1)
+    op = Operator(
+        store, node_name="local",
+        nodes=[RetinaNode(name="local"), RetinaNode(name="remote-1")],
+        capture_manager=CaptureManager(
+            provider=ReplayProvider(source=make_source())),
+        job_runner=runner,
+    )
+    op.start()
+    cap = Capture.from_yaml(yaml.safe_dump({
+        "apiVersion": "retina.sh/v1alpha1",
+        "kind": "Capture",
+        "metadata": {"name": "both", "namespace": "default"},
+        "spec": {
+            "captureTarget": {"nodeNames": ["local", "remote-1"]},
+            "outputConfiguration": {"hostPath": "/tmp/both-out"},
+            "duration": 1,
+        },
+    }))
+    store.apply("Capture", cap)
+    op.wait_capture("both", timeout=60.0)
+    assert cap.status.phase == "Completed", cap.status
+    assert cap.status.jobs_completed == 2
+    assert cap.status.jobs_failed == 0
+    # One artifact from each side.
+    assert any(a.startswith("node://remote-1") for a in
+               cap.status.artifacts)
+    assert any("/tmp/both-out" in a and not a.startswith("node://")
+               for a in cap.status.artifacts)
+    # The remote Job was pinned to the remote node.
+    assert len(FakeBatchApi.jobs) == 1
+
+
+def test_job_manifest_rejects_inexpressible_outputs_and_names():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="hostPath"):
+        job_manifest(dataclasses_replace_output(mk_job(), {}))
+    # Long capture+node names keep the uniqueness suffix and never end
+    # in '-' (DNS-1123), and ttl prevents Job pileup.
+    long_job = mk_job(node="ip-10-0-12-34.us-west-2.compute.internal")
+    long_job = dataclasses_replace(long_job,
+                                   capture_name="a" * 40)
+    doc = job_manifest(long_job)
+    name = doc["metadata"]["name"]
+    assert len(name) <= 63 and not name.endswith("-")
+    assert name[-6] == "-" and name[-5:].isalnum()  # suffix intact
+    assert doc["spec"]["ttlSecondsAfterFinished"] == 3600
+    # packet size + metadata settings reach the workload args.
+    pj = dataclasses_replace(mk_job(), packet_size_bytes=96,
+                             include_metadata=False)
+    args = job_manifest(pj)["spec"]["template"]["spec"][
+        "containers"][0]["args"]
+    assert "--packet-size" in args and "96" in args
+    assert "--no-metadata" in args
+
+
+def dataclasses_replace(job, **kw):
+    import dataclasses
+
+    return dataclasses.replace(job, **kw)
+
+
+def dataclasses_replace_output(job, output):
+    import dataclasses
+
+    return dataclasses.replace(job, output=output)
+
+
+def test_operator_defers_until_node_inventory_synced(batch_apiserver):
+    """A capture arriving before the node watcher's first LIST must not
+    fail with 'unknown nodes' — it defers and reconciles once the
+    inventory lands."""
+    store = CRDStore()
+    inventory: list = []
+    runner = KubeJobRunner(KubeClient(batch_apiserver), poll_s=0.1)
+    op = Operator(
+        store, node_name="local",
+        capture_manager=CaptureManager(
+            provider=ReplayProvider(source=make_source())),
+        job_runner=runner,
+        cluster_nodes=lambda: list(inventory),
+    )
+    op.start()
+    cap = Capture.from_yaml(yaml.safe_dump({
+        "apiVersion": "retina.sh/v1alpha1",
+        "kind": "Capture",
+        "metadata": {"name": "early", "namespace": "default"},
+        "spec": {
+            "captureTarget": {"nodeNames": ["remote-1"]},
+            "outputConfiguration": {"hostPath": "/var/cap"},
+            "duration": 1,
+        },
+    }))
+    store.apply("Capture", cap)
+    time.sleep(1.0)
+    assert cap.status.phase == "Pending"  # deferred, NOT Failed
+    inventory.append(RetinaNode(name="remote-1"))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and cap.status.phase not in (
+            "Completed", "Failed"):
+        time.sleep(0.3)
+    assert cap.status.phase == "Completed", cap.status
+
+
+def test_resync_adopts_remote_jobs_from_dead_leader(batch_apiserver):
+    """Failover: a Running capture whose leader died has live batch/v1
+    Jobs on the cluster — the new leader adopts and settles them
+    instead of marking the capture Failed."""
+    # Seed a Job the "dead leader" created.
+    runner = KubeJobRunner(KubeClient(batch_apiserver), poll_s=0.1)
+    name = runner.create(mk_job())
+    FakeBatchApi.succeed_after = 0  # adopted job reads as succeeded
+
+    store = CRDStore()
+    op = Operator(store, node_name="local", job_runner=runner)
+    op.start()
+    cap = Capture.from_yaml(yaml.safe_dump({
+        "apiVersion": "retina.sh/v1alpha1",
+        "kind": "Capture",
+        "metadata": {"name": "grab", "namespace": "default"},
+        "spec": {
+            "captureTarget": {"nodeNames": ["remote-1"]},
+            "outputConfiguration": {"hostPath": "/var/cap"},
+            "duration": 1,
+        },
+        "status": {"phase": "Running", "jobs_active": 1},
+    }))
+    store.apply("Capture", cap)
+    op.resync()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and cap.status.phase == "Running":
+        time.sleep(0.2)
+    assert cap.status.phase == "Completed", cap.status
+    assert cap.status.jobs_completed == 1
+    assert any("adopted" in a for a in cap.status.artifacts)
