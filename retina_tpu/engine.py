@@ -118,6 +118,7 @@ class SketchEngine:
         self.started = threading.Event()
         self._steps = 0
         self._events_in = 0
+        self._closed_events_in = 0
 
     # -- identity / filter wiring (set by cache & filtermanager) ------
     def update_identities(self, ip_to_index: dict[int, int]) -> None:
@@ -219,8 +220,28 @@ class SketchEngine:
         self._events_in += len(records)
 
     def _close_window(self) -> None:
+        # Idle fast path: end_window SKIPS empty windows on-device (no
+        # flag, no baseline update — AnomalyEWMA.observe active gating),
+        # so when nothing arrived since the last close the dispatch +
+        # readback round-trip is pure waste; an idle agent then costs
+        # zero device traffic between scrapes.
+        if self._events_in == self._closed_events_in:
+            m = get_metrics()
+            m.windows_closed.inc()
+            # Mirror what a real empty close reports (flag 0, z 0,
+            # entropy 0) so a flag raised by the LAST active window
+            # doesn't latch on an idle node.
+            for dim in ("src_ip", "dst_ip", "dst_port"):
+                m.entropy_bits.labels(dimension=dim).set(0.0)
+                m.anomaly_flag.labels(dimension=dim).set(0.0)
+                m.anomaly_zscore.labels(dimension=dim).set(0.0)
+            return
+        ingested = self._events_in
         with self._state_lock:
             self.state, win = self.sharded.end_window(self.state)
+        # Advance only after a SUCCESSFUL close: if end_window raised,
+        # the next tick must retry this window, not skip it forever.
+        self._closed_events_in = ingested
         self.last_window = {k: np.asarray(v) for k, v in win.items()}
         m = get_metrics()
         m.windows_closed.inc()

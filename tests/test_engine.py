@@ -300,3 +300,36 @@ def test_jit_cache_stable_across_ragged_batches():
             mk_records(n, rng.integers(1, 5, n), rng.integers(1, 5, n))
         )
     assert eng.sharded._step._cache_size() == 1
+
+
+def test_idle_window_close_skips_device_and_clears_gauges():
+    """An idle agent's window ticks must cost zero device round-trips,
+    must clear (not latch) the anomaly gauges, and must resume real
+    closes when traffic returns."""
+    from retina_tpu.metrics import get_metrics
+
+    eng = SketchEngine(small_cfg())
+    eng.compile()
+    eng.step_records(mk_records(100, np.full(100, 2), np.full(100, 1)))
+    calls = {"n": 0}
+    real = eng.sharded.end_window
+
+    def counting(state, *a, **kw):
+        calls["n"] += 1
+        return real(state, *a, **kw)
+
+    eng.sharded.end_window = counting
+    eng._close_window()  # has traffic: closes on device
+    assert calls["n"] == 1
+    # Pretend the last window flagged, then go idle.
+    m = get_metrics()
+    m.anomaly_flag.labels(dimension="src_ip").set(1.0)
+    eng._close_window()
+    eng._close_window()
+    assert calls["n"] == 1  # idle ticks: no device call
+    assert m.anomaly_flag.labels(
+        dimension="src_ip")._value.get() == 0.0  # cleared, not latched
+    # Traffic resumes: the close runs again.
+    eng.step_records(mk_records(10, np.full(10, 3), np.full(10, 1)))
+    eng._close_window()
+    assert calls["n"] == 2

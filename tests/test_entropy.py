@@ -72,3 +72,26 @@ def test_merge_additive():
         jnp.ones((200,)),
     )
     assert np.allclose(np.asarray(merged.counts), np.asarray(full.counts))
+
+
+def test_idle_windows_do_not_touch_baseline():
+    """observe(active=False) must be a full no-op (no flag, no baseline
+    update, no warmup credit): an agent idling on a quiet node must not
+    train a zero-entropy baseline that (a) flags the first real traffic
+    and (b) makes a real single-source flood look normal."""
+    ewma = AnomalyEWMA.zeros(1)
+    h_norm = jnp.asarray([7.3], jnp.float32)
+    # Interleave idle windows through the warmup, as a real agent does.
+    for i in range(12):
+        ewma, flag, _ = ewma.observe(h_norm + 0.01 * (i % 3))
+        assert not bool(flag[0])
+        ewma, flag, z = ewma.observe(jnp.asarray([0.0], jnp.float32),
+                                     active=jnp.asarray([False]))
+        assert not bool(flag[0]) and float(z[0]) == 0.0
+    # Idle windows earned no warmup credit and moved no state.
+    assert float(ewma.n_obs[0]) == 12.0
+    assert abs(float(ewma.mean[0]) - 7.3) < 0.1
+    # The attack (zero entropy, active) now flags immediately.
+    ewma, flag, z = ewma.observe(jnp.asarray([0.0], jnp.float32))
+    assert bool(flag[0])
+    assert float(z[0]) < -4.0
