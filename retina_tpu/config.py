@@ -101,6 +101,26 @@ class Config:
     window_seconds: float = 1.0  # entropy/anomaly window
     flush_interval_s: float = 0.05  # max host-side batching latency
     mesh_devices: int = 0  # 0 = all local devices
+    # Host-side RLE combining before the host->device transfer (the eBPF
+    # map pre-aggregation analog, parallel/combine.py). Lossless; off only
+    # for debugging raw row flow.
+    host_combine: bool = True
+    # Depth of the in-flight transfer queue between the batcher thread and
+    # the device dispatch thread (engine.py). 0 = synchronous dispatch on
+    # the feed thread (no overlap).
+    feed_pipeline_depth: int = 2
+    # Smallest power-of-two host->device transfer shape: batches cross the
+    # link at their own (bucketed) size and are padded to batch_capacity
+    # on device, where HBM bandwidth makes padding free (engine pad jit).
+    transfer_min_bucket: int = 1 << 12
+    # 12-lane packed wire format (parallel/wire.py) instead of the 16-lane
+    # schema layout; unpacked on device. Off only for debugging.
+    transfer_packed: bool = True
+    # Under sustained load, accumulate up to this many events per
+    # combine+flush quantum (bigger quanta raise the combine ratio — more
+    # duplicate descriptors per pass — at bounded added latency). The
+    # flush_interval_s timeout still bounds latency at low rates.
+    flush_max_events: int = 1 << 21
     snapshot_dir: str = ""  # sketch-state checkpoint dir ("" = off)
     snapshot_interval_s: float = 0.0  # 0 = only on shutdown
 

@@ -88,6 +88,14 @@ class Metrics:
         )
         self.device_batch_fill = g(mn.DEVICE_BATCH_FILL, [])
         self.windows_closed = c(mn.WINDOWS_CLOSED, [])
+        # events-in / rows-transferred of the host combiner (the kernel-map
+        # aggregation factor; parallel/combine.py). 1.0 = nothing merged.
+        self.combine_ratio = g(mn.COMBINE_RATIO, [])
+        self.transfer_seconds = ex.new_histogram(
+            mn.TRANSFER_SECONDS,
+            [],
+            buckets=[1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0, 3.0],
+        )
 
 
 _singleton: Metrics | None = None

@@ -344,17 +344,17 @@ class TelemetryPipeline:
         qtype = jnp.minimum(col(F.DNS) >> 16, jnp.uint32(Q - 1))
         is_dns = is_dns_req | is_dns_resp
         dns_idx = jnp.where(is_dns, local_pod_c * Q + qtype, jnp.uint32(P * Q))
+        # Every count below weights by F.PACKETS (1 for per-packet events,
+        # N for combined/pre-aggregated rows) so host-side RLE combining
+        # (parallel/combine.py) is exactly lossless.
+        w_dns_req = jnp.where(is_dns_req, packets, 0)
+        w_dns_resp = jnp.where(is_dns_resp, packets, 0)
+        w_retrans = jnp.where(is_retrans, packets, 0)
         pdns = (
             state.pod_dns.reshape(P * Q, 2)
             .at[dns_idx]
             .add(
-                jnp.stack(
-                    [
-                        is_dns_req.astype(jnp.uint32),
-                        is_dns_resp.astype(jnp.uint32),
-                    ],
-                    axis=1,
-                ),
+                jnp.stack([w_dns_req, w_dns_resp], axis=1),
                 mode="drop",
             )
             .reshape(P, Q, 2)
@@ -362,7 +362,7 @@ class TelemetryPipeline:
 
         pret = state.pod_retrans.at[
             jnp.where(is_retrans, local_pod_c, jnp.uint32(P))
-        ].add(jnp.uint32(1), mode="drop")
+        ].add(w_retrans, mode="drop")
 
         # Node counters are plain masked reductions (no scatter needed):
         # each masked forward event contributes to exactly one (dir) cell.
@@ -392,9 +392,7 @@ class TelemetryPipeline:
             pods_known, rep_pkts if low else jnp.where(is_fwd, packets, 0), 0
         )
         svc_hh = state.svc_hh.update([src_pod, dst_pod], svc_w)
-        dns_hh = state.dns_hh.update(
-            [col(F.DNS_QHASH)], jnp.where(is_dns_req, 1, 0).astype(jnp.uint32)
-        )
+        dns_hh = state.dns_hh.update([col(F.DNS_QHASH)], w_dns_req)
 
         sk_mask = report if low else mask
         hll_flows = state.hll_flows.update(
@@ -410,7 +408,7 @@ class TelemetryPipeline:
         ones = (
             rep_pkts.astype(jnp.float32)
             if low
-            else jnp.where(mask, 1.0, 0.0)
+            else jnp.where(mask, packets, 0).astype(jnp.float32)
         )
         ent = state.entropy
         ent = ent.update([src_ip], jnp.zeros_like(src_ip), ones)
@@ -469,15 +467,17 @@ class TelemetryPipeline:
             ]
         )
 
-        n_mask = jnp.sum(mask).astype(jnp.uint32)
+        # totals[0] counts EVENTS REPRESENTED (sum of packet weights), not
+        # rows: a combined row stands for F.PACKETS underlying events.
+        n_events = jnp.sum(jnp.where(mask, packets, 0)).astype(jnp.uint32)
         totals = state.totals + jnp.stack(
             [
-                n_mask,
+                n_events,
                 jnp.sum(w_pkts).astype(jnp.uint32),
                 jnp.sum(jnp.where(is_drop, packets, 0)).astype(jnp.uint32),
-                jnp.sum(is_dns_req).astype(jnp.uint32),
-                jnp.sum(is_dns_resp).astype(jnp.uint32),
-                jnp.sum(is_retrans).astype(jnp.uint32),
+                jnp.sum(w_dns_req).astype(jnp.uint32),
+                jnp.sum(w_dns_resp).astype(jnp.uint32),
+                jnp.sum(w_retrans).astype(jnp.uint32),
                 n_reports,
                 jnp.uint32(0),
             ]
@@ -506,7 +506,7 @@ class TelemetryPipeline:
             lat_hist=lat_hist,
         )
         summary = {
-            "events": n_mask,
+            "events": n_events,
             "ct_reports": n_reports,
             "report_mask": report,
             "report_packets": rep_pkts,

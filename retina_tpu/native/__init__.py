@@ -61,7 +61,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return None
         src_mtime = max(
             os.path.getmtime(os.path.join(_dir, f))
-            for f in ("decoder.cpp", "ring.cpp")
+            for f in ("decoder.cpp", "ring.cpp", "combine.cpp")
         )
         if (not os.path.exists(_so_path)
                 or os.path.getmtime(_so_path) < src_mtime):
@@ -79,6 +79,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32,
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.rt_combine.restype = ctypes.c_long
+        lib.rt_combine.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32),
         ]
         lib.rt_ring_bytes.restype = ctypes.c_size_t
         lib.rt_ring_bytes.argtypes = [ctypes.c_uint64, ctypes.c_uint32]
@@ -128,6 +133,33 @@ def decode_pcap_native(data: bytes, obs_point: int = 2) -> Optional[tuple]:
             max_records *= 2
             continue
         return out[:n], int(total.value)
+
+
+def combine_native(records: np.ndarray) -> Optional[np.ndarray]:
+    """C++ descriptor-RLE combine (combine.cpp). Returns the combined
+    (G, 16) array, or None when the library is unavailable. Semantics
+    match parallel.combine.combine_records_numpy; the ctypes call
+    releases the GIL, so combining overlaps device transfers running on
+    another thread."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(records)
+    if n <= 1:
+        return records
+    if not records.flags.c_contiguous:
+        records = np.ascontiguousarray(records)
+    out = np.empty_like(records)
+    g = lib.rt_combine(
+        records.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+    )
+    if g < 0:
+        return None
+    if g == n:
+        return records
+    return out[:g]
 
 
 class NativeRing:

@@ -1,0 +1,94 @@
+// Host-side record combiner: RLE of identical flow descriptors.
+//
+// The C++ twin of retina_tpu/parallel/combine.py (see that module for the
+// losslessness contract and the eBPF-map analogy). One pass, open
+// addressing: hash the 12 descriptor columns, probe, and either claim an
+// output row or accumulate PACKETS/BYTES (saturating) and take the later
+// timestamp. Order of first appearance is preserved, which the Python
+// fallback does NOT guarantee (it sorts); consumers treat row order as
+// arbitrary.
+//
+// Must stay semantically identical to combine_records_numpy — the test
+// suite cross-checks the two on random batches.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+constexpr int NUM_FIELDS = 16;
+// Field indices (retina_tpu/events/schema.py).
+constexpr int F_TS_LO = 0, F_TS_HI = 1, F_BYTES = 6, F_PACKETS = 7;
+// Descriptor columns: everything except TS_LO/TS_HI/BYTES/PACKETS.
+constexpr int KEY_COLS[12] = {2, 3, 4, 5, 8, 9, 10, 11, 12, 13, 14, 15};
+
+inline uint64_t hash_row(const uint32_t* row) {
+  uint64_t h = 0x9E3779B97F4A7C15ull;
+  for (int c : KEY_COLS) {
+    h ^= row[c];
+    h *= 0xFF51AFD7ED558CCDull;
+    h ^= h >> 33;
+  }
+  return h;
+}
+
+inline bool keys_equal(const uint32_t* a, const uint32_t* b) {
+  for (int c : KEY_COLS)
+    if (a[c] != b[c]) return false;
+  return true;
+}
+
+inline uint32_t sat_add_u32(uint32_t a, uint32_t b) {
+  uint64_t s = (uint64_t)a + b;
+  return s > 0xFFFFFFFFull ? 0xFFFFFFFFu : (uint32_t)s;
+}
+
+}  // namespace
+
+extern "C" {
+
+// rows: (n, 16) u32 row-major. out: caller buffer with room for n rows.
+// Returns the number of combined rows written to out, or -1 on alloc
+// failure. out may alias nothing (distinct buffer required).
+long rt_combine(const uint32_t* rows, size_t n, uint32_t* out) {
+  if (n == 0) return 0;
+  // Table of output indices, power-of-two >= 2n slots; empty = UINT32_MAX.
+  size_t slots = 16;
+  while (slots < 2 * n) slots <<= 1;
+  uint32_t* table = (uint32_t*)malloc(slots * sizeof(uint32_t));
+  if (!table) return -1;
+  memset(table, 0xFF, slots * sizeof(uint32_t));
+  const size_t mask = slots - 1;
+  size_t g = 0;
+  for (size_t i = 0; i < n; i++) {
+    const uint32_t* row = rows + i * NUM_FIELDS;
+    size_t slot = hash_row(row) & mask;
+    for (;;) {
+      uint32_t gid = table[slot];
+      if (gid == 0xFFFFFFFFu) {
+        table[slot] = (uint32_t)g;
+        memcpy(out + g * NUM_FIELDS, row, NUM_FIELDS * sizeof(uint32_t));
+        g++;
+        break;
+      }
+      uint32_t* orow = out + (size_t)gid * NUM_FIELDS;
+      if (keys_equal(orow, row)) {
+        orow[F_PACKETS] = sat_add_u32(orow[F_PACKETS], row[F_PACKETS]);
+        orow[F_BYTES] = sat_add_u32(orow[F_BYTES], row[F_BYTES]);
+        uint64_t ots = ((uint64_t)orow[F_TS_HI] << 32) | orow[F_TS_LO];
+        uint64_t nts = ((uint64_t)row[F_TS_HI] << 32) | row[F_TS_LO];
+        if (nts > ots) {
+          orow[F_TS_LO] = row[F_TS_LO];
+          orow[F_TS_HI] = row[F_TS_HI];
+        }
+        break;
+      }
+      slot = (slot + 1) & mask;
+    }
+  }
+  free(table);
+  return (long)g;
+}
+
+}  // extern "C"

@@ -65,47 +65,84 @@ class ShardedBatch:
 
     records: np.ndarray  # (D, B, NUM_FIELDS) uint32
     n_valid: np.ndarray  # (D,) uint32
-    lost: int  # rows dropped because a shard overflowed
+    lost: int  # EVENTS dropped because a shard overflowed (sum of the
+    # dropped rows' F.PACKETS weights — a combined row stands for many
+    # events, parallel/combine.py)
+
+
+def _next_bucket(n: int) -> int:
+    """Smallest m * 2^k >= n with mantissa m in {4,5,6,7}: transfer
+    shapes quantize to within 25% of the payload (vs up to 100% for pure
+    powers of two) while keeping the distinct-shape count — and thus the
+    engine's per-shape ingest jits — small."""
+    if n <= 4:
+        return max(n, 1)
+    k = (n - 1).bit_length() - 3  # so that 4*2^k <= n-1 < 8*2^k... scaled
+    step = 1 << k
+    return ((n + step - 1) // step) * step
 
 
 def partition_events(
-    records: np.ndarray, n_devices: int, capacity: int
+    records: np.ndarray,
+    n_devices: int,
+    capacity: int,
+    min_bucket: int | None = None,
 ) -> ShardedBatch:
-    """Split (N, F) valid records into a (D, B, F) sharded batch.
+    """Split (N, F) valid records into a (D, B', F) sharded batch.
 
     Overflowing rows are dropped and counted, never blocked on (the
     reference's universal backpressure rule, SURVEY.md §3.2).
 
-    ALIASING CONTRACT: for ``n_devices == 1`` with a full contiguous
-    batch, ``records`` is returned as a zero-copy VIEW — consume the
-    ShardedBatch (e.g. ``jax.device_put``, as the engine does) before
-    reusing the input buffer. Multi-device output is always a fresh
-    array.
+    ``min_bucket=None`` emits the full (D, capacity, F) shape. With an
+    integer, the minor batch dim B' is the smallest bucket (see
+    ``_next_bucket``) >= max(shard fill, min_bucket), capped at capacity —
+    so a lightly-filled batch crosses the host->device link at its own
+    size and is padded to the step's static (D, capacity, F) shape ON
+    DEVICE (engine ingest jit), where HBM bandwidth makes the padding
+    free. Quantized buckets keep the number of distinct transfer shapes
+    (and ingest-kernel compiles) logarithmic.
+
+    ALIASING CONTRACT: for ``n_devices == 1`` with a bucket-full
+    contiguous batch, ``records`` is returned as a zero-copy VIEW —
+    consume the ShardedBatch (e.g. ``jax.device_put``, as the engine
+    does) before reusing the input buffer. Multi-device output is always
+    a fresh array.
     """
     assert records.ndim == 2 and records.shape[1] == NUM_FIELDS
+
+    def bucket_for(n_max: int) -> int:
+        if min_bucket is None:
+            return capacity
+        return min(_next_bucket(max(n_max, min_bucket)), capacity)
+
     if n_devices == 1:
         # Fast path: one shard takes everything — no connection hashing,
         # and a full batch is a zero-copy reshape (the hash pass cost
         # ~22 ms per 131k-event batch, dominating the host feed loop).
         n = min(len(records), capacity)
-        lost = len(records) - n
-        if n == capacity:
-            out = np.ascontiguousarray(records[:capacity], np.uint32)
-            out = out.reshape(1, capacity, NUM_FIELDS)
+        lost = int(records[n:, F.PACKETS].astype(np.uint64).sum())
+        b = bucket_for(n)
+        if n == b:
+            out = np.ascontiguousarray(records[:n], np.uint32)
+            out = out.reshape(1, b, NUM_FIELDS)
         else:
-            out = np.zeros((1, capacity, NUM_FIELDS), np.uint32)
+            out = np.zeros((1, b, NUM_FIELDS), np.uint32)
             out[0, :n] = records[:n]
         return ShardedBatch(records=out,
                             n_valid=np.array([n], np.uint32), lost=lost)
-    out = np.zeros((n_devices, capacity, NUM_FIELDS), np.uint32)
     n_valid = np.zeros((n_devices,), np.uint32)
     lost = 0
     if len(records):
         dev = canonical_conn_hash(records) % np.uint32(n_devices)
+        counts = np.bincount(dev, minlength=n_devices)
+        b = bucket_for(int(min(counts.max(), capacity)))
+        out = np.zeros((n_devices, b, NUM_FIELDS), np.uint32)
         for d in range(n_devices):
             rows = records[dev == d]
             n = min(len(rows), capacity)
             out[d, :n] = rows[:n]
             n_valid[d] = n
-            lost += len(rows) - n
+            lost += int(rows[n:, F.PACKETS].astype(np.uint64).sum())
+    else:
+        out = np.zeros((n_devices, bucket_for(0), NUM_FIELDS), np.uint32)
     return ShardedBatch(records=out, n_valid=n_valid, lost=lost)

@@ -136,7 +136,11 @@ class ShardedTelemetry:
             ident,
             jnp.asarray(apiserver_ip, jnp.uint32),
             filter_map,
-            jnp.asarray(lost, jnp.uint32),
+            # Packet-weighted loss counts can exceed 2^32 in one batch;
+            # the device totals are u32 and wrap (like every reference
+            # kernel counter) — the host-side Prometheus lost_events
+            # counter (float64) stays exact.
+            jnp.asarray(int(lost) & 0xFFFFFFFF, jnp.uint32),
         )
 
     # ------------------------------------------------------------------

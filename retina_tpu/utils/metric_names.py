@@ -65,6 +65,8 @@ PARSED_PACKETS = PREFIX + "parsed_packets_counter"
 DEVICE_STEP_SECONDS = PREFIX + "tpu_step_seconds"
 DEVICE_BATCH_FILL = PREFIX + "tpu_batch_fill_ratio"
 WINDOWS_CLOSED = PREFIX + "tpu_windows_closed"
+COMBINE_RATIO = PREFIX + "host_combine_ratio"
+TRANSFER_SECONDS = PREFIX + "tpu_transfer_seconds"
 
 # Label keys (reference pkg/utils/metric_names.go label constants).
 L_DIRECTION = "direction"

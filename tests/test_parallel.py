@@ -267,5 +267,9 @@ def test_partition_single_device_fast_path():
 
     over = rng.integers(0, 2**31, size=(cap + 40, NUM_FIELDS),
                         dtype=np.int64).astype(np.uint32)
+    # Losses are counted in EVENTS (packet weights), not rows: a combined
+    # row stands for F.PACKETS underlying events.
+    over[:, F.PACKETS] = 1
+    over[-1, F.PACKETS] = 5
     sb = partition_events(over, 1, cap)
-    assert int(sb.n_valid[0]) == cap and sb.lost == 40
+    assert int(sb.n_valid[0]) == cap and sb.lost == 39 + 5
