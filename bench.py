@@ -197,13 +197,217 @@ def run(smoke: bool) -> dict:
     return out
 
 
+def _measure_link_bandwidth() -> float:
+    """Median host->device bandwidth (MB/s) for a transfer-sized buffer.
+
+    On production TPU hosts this is PCIe (GB/s); on the bench harness the
+    chip sits behind a network tunnel whose bandwidth varies minute to
+    minute — measuring it alongside the e2e number makes that number
+    interpretable."""
+    import jax
+
+    a = np.random.default_rng(0).integers(
+        0, 2**31, size=(1 << 18, 12), dtype=np.int64
+    ).astype(np.uint32)
+    import jax.numpy as jnp
+
+    jax.device_put(a).block_until_ready()  # warm (and compile the sum)
+    float(jnp.sum(jax.device_put(a)))
+    rates = []
+    for i in range(3):
+        a[:, 0] += np.uint32(i + 1)  # bust any content-hash transfer cache
+        t0 = time.perf_counter()
+        # Force real materialization on device: a compute round trip on
+        # the transferred buffer, not just a future handle.
+        float(jnp.sum(jax.device_put(a)))
+        rates.append(a.nbytes / 1e6 / (time.perf_counter() - t0))
+    return sorted(rates)[1]
+
+
+def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
+    """Full-system benchmark: boot the REAL agent (daemon: plugins ->
+    sink -> combine/pack/partition feed -> device step -> metrics module
+    -> HTTP /metrics) and measure sustained flow-events/s plus scrape
+    latency over live HTTP — the loop the reference runs in
+    pkg/module/metrics/metrics_module.go:266-330, measured end to end
+    against the BASELINE north star (10M ev/s/node, <1s scrape)."""
+    import threading
+    import urllib.request
+
+    from retina_tpu.common import RetinaEndpoint
+    from retina_tpu.config import (
+        Config, DEFAULT_CACHE_DIR, enable_compilation_cache,
+    )
+    from retina_tpu.daemon import Daemon
+    from retina_tpu.metrics import get_metrics
+
+    enable_compilation_cache(DEFAULT_CACHE_DIR)
+    dur = duration_s if duration_s is not None else (8.0 if smoke else 40.0)
+    warmup = 2.0 if smoke else 5.0
+
+    link_mbs = _measure_link_bandwidth()
+    log(f"e2e: link bandwidth probe {link_mbs:.0f} MB/s")
+
+    # Host-path capability probe (no device): combine + pack + partition
+    # of one flush quantum — the ceiling the host CPU side imposes when
+    # the link stops being the bottleneck (production PCIe).
+    from retina_tpu.events.synthetic import TrafficGen
+    from retina_tpu.parallel.combine import combine_records
+    from retina_tpu.parallel.partition import partition_events
+    from retina_tpu.parallel.wire import pack_records
+
+    probe_gen = TrafficGen(
+        n_flows=50_000 if smoke else 1_000_000,
+        n_pods=256 if smoke else 2048, seed=7,
+    )
+    quantum = np.concatenate(
+        [probe_gen.batch(1 << 17) for _ in range(2 if smoke else 16)]
+    )
+    t0 = time.perf_counter()
+    comb = combine_records(quantum)
+    pack_records(
+        partition_events(comb, 1, 1 << 19, min_bucket=1 << 12).records
+    )
+    host_path_rate = len(quantum) / (time.perf_counter() - t0)
+    log(f"e2e: host-path probe {host_path_rate / 1e6:.1f}M ev/s "
+        f"(combine ratio {len(quantum) / len(comb):.1f})")
+
+    cfg = Config()
+    cfg.api_server_addr = "127.0.0.1:0"
+    cfg.enabled_plugins = ["packetparser"]
+    cfg.event_source = "synthetic"
+    cfg.synthetic_rate = 1e12  # unthrottled: measure the system ceiling
+    cfg.synthetic_flows = 50_000 if smoke else 1_000_000
+    cfg.synthetic_pregen = 16 if smoke else 256  # 131k / 2.1M event ring
+    cfg.batch_capacity = 1 << (14 if smoke else 19)
+    cfg.bypass_lookup_ip_of_interest = True
+    n_pods = 256 if smoke else 2048
+
+    d = Daemon(cfg)
+    for i in range(1, n_pods):
+        d.cm.cache.update_endpoint(
+            RetinaEndpoint(
+                name=f"pod-{i}", namespace="default",
+                ips=(f"10.0.{(i >> 8) & 0xFF}.{i & 0xFF}",),
+            )
+        )
+    stop = threading.Event()
+    t = threading.Thread(target=d.start, args=(stop,), daemon=True)
+    t.start()
+    log("e2e: agent booting (compile from persistent cache)")
+    deadline = time.monotonic() + 300
+    port = None
+    while time.monotonic() < deadline:
+        if d.cm.server is not None and d.cm.engine.started.is_set():
+            try:
+                port = d.cm.server.port
+                break
+            except AssertionError:
+                pass
+        time.sleep(0.2)
+    if port is None:
+        stop.set()
+        raise RuntimeError("e2e: agent did not come up in 300s")
+    log(f"e2e: agent up on :{port}; warmup {warmup:.0f}s")
+
+    def scrape() -> tuple[float, str]:
+        t0 = time.perf_counter()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
+        return time.perf_counter() - t0, body
+
+    eng = d.cm.engine
+    m = get_metrics()
+    time.sleep(warmup)
+    ev0 = eng._events_in
+    bytes0 = m.transfer_bytes._value.get()
+    t0 = time.monotonic()
+    lat: list[float] = []
+    while time.monotonic() - t0 < dur:
+        dt, _ = scrape()
+        lat.append(dt)
+        time.sleep(max(0.0, 1.0 - dt))
+    elapsed = time.monotonic() - t0
+    ev1 = eng._events_in
+    bytes1 = m.transfer_bytes._value.get()
+    rate = (ev1 - ev0) / elapsed
+    _, body = scrape()
+    stop.set()
+    t.join(60)
+
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(int(len(lat) * 0.99), len(lat) - 1)]
+    wire_bpe = (bytes1 - bytes0) / max(ev1 - ev0, 1)
+    combine_ratio = m.combine_ratio._value.get()
+    # Sanity: the exposition must carry the data-plane families.
+    assert "networkobservability_forward_count" in body
+    if wire_bpe * rate / 1e6 >= 0.5 * link_mbs:
+        bottleneck = "host->device link bandwidth"
+    elif rate < 0.5 * host_path_rate:
+        # Wire is underfed AND the host side can go much faster: the
+        # remaining cost is per-dispatch round-trip latency to the
+        # device runtime (tunnel RTT on this harness).
+        bottleneck = "device dispatch round-trip latency"
+    else:
+        bottleneck = "host feed path"
+    res = {
+        "events_per_sec": round(rate),
+        "scrape_p50_ms": round(p50 * 1e3, 1),
+        "scrape_p99_ms": round(p99 * 1e3, 1),
+        "scrapes": len(lat),
+        "duration_s": round(elapsed, 1),
+        "combine_ratio": round(combine_ratio, 2),
+        "wire_bytes_per_event": round(wire_bpe, 2),
+        "link_bandwidth_mbs": round(link_mbs, 1),
+        "bottleneck": bottleneck,
+        "host_path_events_per_sec": round(host_path_rate),
+        # What the measured wire efficiency implies on a production PCIe
+        # host (~8 GB/s nominal): the link stops binding and the host
+        # feed path (combine/pack/partition, measured above) becomes the
+        # per-node ceiling.
+        "projected_pcie_events_per_sec": round(
+            min(8e9 / max(wire_bpe, 1e-9), host_path_rate)
+        ),
+    }
+    log(f"e2e: {rate / 1e6:.2f}M ev/s sustained, scrape p50 "
+        f"{res['scrape_p50_ms']}ms p99 {res['scrape_p99_ms']}ms, "
+        f"{wire_bpe:.1f} wire B/ev, link {link_mbs:.0f} MB/s")
+    return res
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="reduced shapes, completes in <60s")
+    ap.add_argument("--e2e", action="store_true",
+                    help="full-system bench only (agent boot -> scrape)")
+    ap.add_argument("--no-e2e", action="store_true",
+                    help="skip the e2e phase of the default run")
     args = ap.parse_args()
     try:
-        out = run(args.smoke)
+        if args.e2e:
+            e2e = run_e2e(args.smoke)
+            out = {
+                "metric": "flow_events_per_sec_e2e",
+                "value": e2e["events_per_sec"],
+                "unit": "events/s",
+                "vs_baseline": round(e2e["events_per_sec"] / 10_000_000, 4),
+                "extra": e2e,
+            }
+        else:
+            out = run(args.smoke)
+            if not args.no_e2e:
+                # Default run carries the system number alongside the
+                # device-step number so one JSON line captures both.
+                try:
+                    out["extra"]["e2e"] = run_e2e(args.smoke)
+                except Exception as e:  # noqa: BLE001
+                    log("e2e phase FAILED:\n" + traceback.format_exc())
+                    out["extra"]["e2e"] = {
+                        "error": f"{type(e).__name__}: {e}".splitlines()[0][:400]
+                    }
     except Exception as e:  # noqa: BLE001 — always emit the JSON line
         log("FAILED:\n" + traceback.format_exc())
         out = {

@@ -82,6 +82,11 @@ class Config:
     pcap_loop: bool = True  # loop the replay
     synthetic_rate: float = 1e6  # target events/s for the generator
     synthetic_flows: int = 100_000
+    # Pre-generate this many 8192-event blocks at compile() and cycle
+    # them in the feed loop (0 = generate live). Keeps the numpy
+    # generator out of the hot loop for max-rate benchmarking — the
+    # trafficgen-replay analog.
+    synthetic_pregen: int = 0
     capture_iface: str = ""  # live AF_PACKET interface ("" = default)
     external_socket: str = "/tmp/retina-events.sock"  # external feed
     # pktmon plugin (Windows): stream-server command + its socket. ""

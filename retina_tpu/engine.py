@@ -40,6 +40,7 @@ from retina_tpu.parallel.combine import combine_records
 from retina_tpu.parallel.partition import ShardedBatch, partition_events
 from retina_tpu.parallel.telemetry import ShardedTelemetry, topk_from_snapshot
 from retina_tpu.plugins.api import QueueSink
+from retina_tpu.utils.device_proxy import run_on_device
 
 
 def pipeline_config_from(cfg: Config) -> PipelineConfig:
@@ -103,7 +104,10 @@ class SketchEngine:
 
         self._ident_lock = threading.Lock()
         self.ident = IdentityMap.zeros(cfg.identity_slots)
-        self.filter_map = IdentityMap.zeros(1 << 10, seed=99)
+        # Sized like the identity table: the default deployment loads
+        # every tracked pod IP into the IPs-of-interest map (the metrics
+        # module filter sync), so 1024 slots overflowed at ~500 pods.
+        self.filter_map = IdentityMap.zeros(cfg.identity_slots, seed=99)
         self.apiserver_ip = 0
         # Persistent host mirror for incremental identity churn: one pod
         # event costs O(chain) host mutations + one upload, not a full
@@ -151,12 +155,23 @@ class SketchEngine:
                 if old.get(ip) != idx:
                     self._ident_host.insert(ip, idx)
             self._ident_dict = new
-            self.ident = self._ident_host.to_device()
+            # Device upload on the proxy thread (all JAX interaction is
+            # single-threaded through it; utils/device_proxy.py).
+            self.ident = run_on_device(self._ident_host.to_device)
 
     def update_filter_ips(self, ips: set[int]) -> None:
-        fmap = IdentityMap.build_host(
-            {ip: 1 for ip in ips}, n_slots=1 << 10, seed=99
-        )
+        # Build the cuckoo table on the CALLING thread (pure numpy, O(n)
+        # host work); only the device upload ties up the proxy thread.
+        host = HostIdentityTable(n_slots=self.cfg.identity_slots, seed=99)
+        if len(ips) > host.capacity:
+            raise ValueError(
+                f"filter map overfull: {len(ips)} IPs into "
+                f"{self.cfg.identity_slots} slots"
+            )
+        for ip in ips:
+            if ip:
+                host.insert(ip, 1)
+        fmap = run_on_device(host.to_device)
         with self._ident_lock:
             self.filter_map = fmap
 
@@ -173,24 +188,33 @@ class SketchEngine:
         """Warm every jit cache (the clang-compile analog) so the feed
         loop and the first scrape never pay compile latency."""
         t0 = time.perf_counter()
-        zero = jax.device_put(
-            np.zeros(
-                (self.n_devices, self.cfg.batch_capacity, NUM_FIELDS),
-                np.uint32,
-            ),
-            self._rec_sharding,  # same placement as _dispatch, same jit key
-        )
-        nv = np.zeros((self.n_devices,), np.uint32)
-        self.state, _ = self.sharded.step(
-            self.state, zero, nv, 1, self.ident, self.apiserver_ip,
-            filter_map=self.filter_map,
-        )
-        self.state, _ = self.sharded.end_window(self.state)
-        snap = self.sharded.snapshot(self.state, 1)
-        jax.block_until_ready(snap["totals"])
+
+        def warm():
+            zero = jax.device_put(
+                np.zeros(
+                    (self.n_devices, self.cfg.batch_capacity, NUM_FIELDS),
+                    np.uint32,
+                ),
+                self._rec_sharding,  # same placement as step, same jit key
+            )
+            nv = np.zeros((self.n_devices,), np.uint32)
+            self.state, _ = self.sharded.step(
+                self.state, zero, nv, 1, self.ident, self.apiserver_ip,
+                filter_map=self.filter_map,
+            )
+            self.state, _ = self.sharded.end_window(self.state)
+            # Warm BOTH snapshot programs: the device-dict one (tests,
+            # direct consumers) and the flat single-transfer one the
+            # scrape path uses (a cold compile here cost the first
+            # scrape ~40s on the tunnel).
+            snap = self.sharded.snapshot(self.state, 1)
+            jax.block_until_ready(snap["totals"])
+            self.sharded.snapshot_host(self.state, 1)
+
+        run_on_device(warm)
         # Warm the bucketed-ingest jits (wire unpack + pad) for the
-        # smallest bucket; other power-of-two buckets compile on first
-        # use (same tiny kernel, ~sub-second each).
+        # smallest bucket; other buckets compile on first use (same tiny
+        # kernel, ~sub-second each).
         self._dispatch(
             np.zeros((0, NUM_FIELDS), np.uint32), now_s=1
         )
@@ -248,32 +272,36 @@ class SketchEngine:
         m = get_metrics()
         if sb.lost:
             m.lost_events.labels(stage="partition", plugin="engine").inc(sb.lost)
-        # Host->device transfer happens here, before the lock: a scrape
-        # thread dispatching a snapshot never waits on the copy, and this
-        # thread holds the lock only for the (async) step dispatch.
+        # Packing stays on the calling thread (host CPU work overlaps the
+        # proxy's in-flight transfer); the transfer + step dispatch run
+        # on the device-proxy thread.
         tt = time.perf_counter()
         if self.cfg.transfer_packed:
             from retina_tpu.parallel.wire import pack_records
 
-            packed, b_lo, b_hi = pack_records(sb.records)
-            rec_dev = jax.device_put(packed, self._rec_sharding)
-            rec_dev = self._ingest_fn(packed.shape[1], True)(
-                rec_dev, jnp.uint32(b_lo), jnp.uint32(b_hi)
-            )
+            wire, b_lo, b_hi = pack_records(sb.records)
+            packed = True
         else:
-            rec_dev = jax.device_put(sb.records, self._rec_sharding)
-            if sb.records.shape[1] != self.cfg.batch_capacity:
-                zero = jnp.uint32(0)
-                rec_dev = self._ingest_fn(sb.records.shape[1], False)(
-                    rec_dev, zero, zero
+            wire, b_lo, b_hi = sb.records, np.uint32(0), np.uint32(0)
+            packed = False
+        m.transfer_bytes.inc(wire.nbytes)
+
+        def xfer_and_step():
+            rec_dev = jax.device_put(wire, self._rec_sharding)
+            if packed or wire.shape[1] != self.cfg.batch_capacity:
+                rec_dev = self._ingest_fn(wire.shape[1], packed)(
+                    rec_dev, jnp.uint32(b_lo), jnp.uint32(b_hi)
                 )
-        m.transfer_seconds.observe(time.perf_counter() - tt)
-        t0 = time.perf_counter()
-        with self._state_lock:
-            self.state, _ = self.sharded.step(
-                self.state, rec_dev, sb.n_valid, now_s, ident,
-                self.apiserver_ip, filter_map=fmap, lost=sb.lost,
-            )
+            t0 = time.perf_counter()
+            with self._state_lock:
+                self.state, _ = self.sharded.step(
+                    self.state, rec_dev, sb.n_valid, now_s, ident,
+                    self.apiserver_ip, filter_map=fmap, lost=sb.lost,
+                )
+            return t0
+
+        t0 = run_on_device(xfer_and_step)
+        m.transfer_seconds.observe(t0 - tt)
         m.device_step_seconds.observe(time.perf_counter() - t0)
         m.device_batch_fill.set(float(sb.n_valid.sum()) / (
             self.n_devices * self.cfg.batch_capacity))
@@ -298,12 +326,17 @@ class SketchEngine:
                 m.anomaly_zscore.labels(dimension=dim).set(0.0)
             return
         ingested = self._events_in
-        with self._state_lock:
-            self.state, win = self.sharded.end_window(self.state)
+
+        def close():
+            with self._state_lock:
+                self.state, win = self.sharded.end_window(self.state)
+            return jax.device_get(win)
+
+        win_host = run_on_device(close)
         # Advance only after a SUCCESSFUL close: if end_window raised,
         # the next tick must retry this window, not skip it forever.
         self._closed_events_in = ingested
-        self.last_window = {k: np.asarray(v) for k, v in win.items()}
+        self.last_window = win_host
         m = get_metrics()
         m.windows_closed.inc()
         dims = ["src_ip", "dst_ip", "dst_port"]
@@ -369,9 +402,32 @@ class SketchEngine:
             )
             worker.start()
 
+        def drop_item(item):
+            """Dead-worker path: account the loss, never enqueue into a
+            queue nobody drains (silent vanishing)."""
+            self.log.error("dispatch worker dead; dropping %s", item[0])
+            if item[0] == "step":
+                n = int(item[1].n_valid.sum())
+                get_metrics().lost_events.labels(
+                    stage="dispatch", plugin="engine"
+                ).inc(n)
+
         def submit(item):
             if q is not None:
-                q.put(item)
+                # Block only while the worker lives: if it died (fatal
+                # runtime error escaping its catch), drop + count rather
+                # than wedging the feed loop on a full queue forever —
+                # and check liveness BEFORE enqueueing, or items that
+                # still fit in the queue would vanish uncounted.
+                while True:
+                    if not worker.is_alive():
+                        drop_item(item)
+                        return
+                    try:
+                        q.put(item, timeout=1.0)
+                        return
+                    except queue_mod.Full:
+                        pass
             elif item[0] == "step":
                 self._dispatch_sharded(item[1], item[2], item[3])
             else:
@@ -453,12 +509,17 @@ class SketchEngine:
         with self._snap_lock:
             if self._snap_cache is not None and now - self._snap_time < max_age_s:
                 return self._snap_cache
-        with self._state_lock:
-            dev_snap = self.sharded.snapshot(self.state, int(time.time()))
-        # ONE batched device→host transfer for the whole tree: per-leaf
-        # np.asarray would pay a blocking tunnel round-trip per array
-        # (measured >2s at production shapes vs the <1s scrape budget).
-        host = jax.device_get(dev_snap)
+        def snap():
+            # ONE device->host transfer for the whole tree (leaves are
+            # concatenated on device): per-leaf readback paid a full
+            # link round trip per array — measured 2.7-21s at production
+            # shapes on a congested link vs the <1s scrape budget.
+            with self._state_lock:
+                return self.sharded.snapshot_host(
+                    self.state, int(time.time())
+                )
+
+        host = run_on_device(snap)
         host["steps"] = self._steps
         host["events_in"] = self._events_in
         with self._snap_lock:
@@ -501,11 +562,17 @@ class SketchEngine:
     def save_snapshot_state(self, path: str) -> None:
         from retina_tpu.checkpoint import save_state
 
-        with self._state_lock:
-            save_state(path, self.state, self.pcfg)
+        def save():
+            with self._state_lock:
+                save_state(path, self.state, self.pcfg)
+
+        run_on_device(save)
 
     def load_snapshot_state(self, path: str) -> None:
         from retina_tpu.checkpoint import load_state
 
-        with self._state_lock:
-            self.state = load_state(path, self.sharded, self.pcfg)
+        def load():
+            with self._state_lock:
+                self.state = load_state(path, self.sharded, self.pcfg)
+
+        run_on_device(load)

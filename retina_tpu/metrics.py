@@ -96,6 +96,7 @@ class Metrics:
             [],
             buckets=[1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0, 3.0],
         )
+        self.transfer_bytes = c(mn.TRANSFER_BYTES, [])
 
 
 _singleton: Metrics | None = None

@@ -18,6 +18,7 @@ from pubsub add/remove pod IPs in the filtermanager under requestor
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Optional
 
 from retina_tpu.common import (
@@ -162,9 +163,16 @@ class MetricsModule:
                 self._log.exception("metric %s publish failed", name)
 
     def start(self, stop: threading.Event) -> None:
+        # Adaptive cadence: the 1 s module interval
+        # (metrics_module.go:37) assumes snapshot readback is cheap. On a
+        # slow host<->device link a fresh snapshot can cost seconds; keep
+        # the publisher's duty cycle <= ~50% so it never monopolizes the
+        # device transport against the feed path.
         while not stop.is_set():
+            t0 = time.perf_counter()
             try:
                 self.publish_once()
             except Exception:
                 self._log.exception("publish cycle failed")
-            stop.wait(PUBLISH_INTERVAL_S)
+            cost = time.perf_counter() - t0
+            stop.wait(max(PUBLISH_INTERVAL_S, cost))

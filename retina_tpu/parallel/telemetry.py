@@ -49,6 +49,7 @@ class ShardedTelemetry:
         self._step = None
         self._end_window = None
         self._snapshot = None
+        self._snapshot_flat = None
 
     # ------------------------------------------------------------------
     def init_state(self) -> PipelineState:
@@ -246,6 +247,56 @@ class ShardedTelemetry:
         if self._snapshot is None:
             self._snapshot = self._build_snapshot()
         return self._snapshot(state, jnp.asarray(now_s, jnp.uint32))
+
+    # ------------------------------------------------------------------
+    def _build_snapshot_flat(self, state: PipelineState):
+        base = self._build_snapshot()
+        shapes = jax.eval_shape(base, state, jnp.uint32(0))
+        leaves, treedef = jax.tree_util.tree_flatten(shapes)
+
+        def flat_fn(st, now_s):
+            d = base(st, now_s)
+            out = []
+            for leaf in jax.tree_util.tree_leaves(d):
+                if leaf.dtype != jnp.uint32:
+                    leaf = jax.lax.bitcast_convert_type(
+                        leaf.astype(
+                            jnp.float32
+                            if jnp.issubdtype(leaf.dtype, jnp.floating)
+                            else jnp.uint32
+                        ),
+                        jnp.uint32,
+                    )
+                out.append(leaf.reshape(-1))
+            return jnp.concatenate(out)
+
+        return jax.jit(flat_fn), leaves, treedef
+
+    def snapshot_host(self, state: PipelineState, now_s) -> dict[str, Any]:
+        """Merged snapshot delivered to HOST memory in ONE device->host
+        transfer: every leaf is bitcast to u32, raveled, and concatenated
+        on device, so the readback is a single contiguous buffer instead
+        of ~25 per-leaf round trips (each round trip costs full link
+        latency; measured 2.7-21s per scrape on a congested link vs the
+        <1s budget)."""
+        if self._snapshot_flat is None:
+            self._snapshot_flat = self._build_snapshot_flat(state)
+        fn, leaf_shapes, treedef = self._snapshot_flat
+        flat = np.asarray(fn(state, jnp.asarray(now_s, jnp.uint32)))
+        out = []
+        off = 0
+        for spec in leaf_shapes:
+            n = int(np.prod(spec.shape)) if spec.shape else 1
+            chunk = flat[off : off + n]
+            off += n
+            if np.issubdtype(spec.dtype, np.floating):
+                chunk = chunk.view(np.float32).astype(spec.dtype)
+            elif chunk.dtype != spec.dtype:
+                chunk = chunk.view(np.uint32).astype(spec.dtype)
+            out.append(
+                chunk.reshape(spec.shape) if spec.shape else chunk[0]
+            )
+        return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def topk_from_snapshot(

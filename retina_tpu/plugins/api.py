@@ -95,11 +95,13 @@ class Plugin(abc.ABC):
         """External (Hubble-path) queue (registry.go:31-33)."""
         self.external = q
 
-    def emit(self, records: np.ndarray) -> None:
+    def emit(self, records: np.ndarray) -> int:
         """Write records to sink + mirror to external channel, never
-        blocking; losses are counted (packetparser_linux.go:645-651)."""
+        blocking; losses are counted (packetparser_linux.go:645-651).
+        Returns rows the sink accepted so paced sources can yield
+        instead of busy-spinning against a full sink."""
         if len(records) == 0:
-            return
+            return 0
         accepted = self.sink.write_records(records, self.name)
         if accepted < len(records):
             self.count_lost("buffered", len(records) - accepted)
@@ -109,6 +111,7 @@ class Plugin(abc.ABC):
             except queue_mod.Full:
                 self._external_lost += len(records)
                 self.count_lost("external", len(records))
+        return accepted
 
     def count_lost(self, stage: str, n: int) -> None:
         from retina_tpu.metrics import get_metrics

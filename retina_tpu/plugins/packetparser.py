@@ -39,6 +39,7 @@ class PacketParserPlugin(Plugin):
     def __init__(self, cfg: Config):
         super().__init__(cfg)
         self._gen: TrafficGen | None = None
+        self._pregen: list[np.ndarray] | None = None
         self._pcap_records: np.ndarray | None = None
         self.dns_names: dict[int, str] = {}
         self._sock = None
@@ -59,6 +60,21 @@ class PacketParserPlugin(Plugin):
             self._gen = TrafficGen(
                 n_flows=self.cfg.synthetic_flows, n_pods=self.cfg.n_pods
             )
+            if self.cfg.synthetic_pregen > 0:
+                # Generate in large chunks (per-call cost of the Zipf
+                # sampler is O(n_flows)) and slice into emit-sized blocks.
+                total = self.cfg.synthetic_pregen * BLOCK
+                chunk = BLOCK * 16
+                self._pregen = []
+                for off in range(0, total, chunk):
+                    a = self._gen.batch(min(chunk, total - off))
+                    self._pregen += [
+                        a[i : i + BLOCK] for i in range(0, len(a), BLOCK)
+                    ]
+                self.log.info(
+                    "pre-generated %d blocks (%d events)",
+                    len(self._pregen), total,
+                )
         elif src == "pcap":
             from retina_tpu.sources.pcapdecode import decode_pcap_file
 
@@ -118,12 +134,23 @@ class PacketParserPlugin(Plugin):
         assert self._gen is not None
         per_block_s = BLOCK / max(self.cfg.synthetic_rate, 1.0)
         next_t = time.monotonic()
+        i = 0
         while not stop.is_set():
-            self.emit(self._gen.batch(BLOCK))
+            if self._pregen is not None:
+                block = self._pregen[i % len(self._pregen)]
+                i += 1
+            else:
+                block = self._gen.batch(BLOCK)
+            accepted = self.emit(block)
             next_t += per_block_s
             delay = next_t - time.monotonic()
             if delay > 0:
                 stop.wait(delay)
+            elif accepted == 0:
+                # Sink full and unpaced: yield instead of busy-spinning
+                # (the loss is already counted; a hot loop here only
+                # starves the feed thread of the GIL).
+                stop.wait(0.001)
             else:
                 next_t = time.monotonic()  # behind: don't accumulate debt
 
