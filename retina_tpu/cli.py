@@ -423,6 +423,31 @@ def cmd_relay(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_deploy_render(args: argparse.Namespace) -> int:
+    """Render the helm chart without a helm binary (air-gapped installs,
+    kubectl-apply pipelines; reference drives helm through its SDK in
+    deploy/standard/*.go — here helmlite renders the same chart)."""
+    from retina_tpu.utils.helmlite import render_chart
+
+    rendered = render_chart(
+        args.chart,
+        release_name=args.release,
+        namespace=args.namespace,
+        values_files=args.values or [],
+        set_values=args.set or [],
+    )
+    first = True
+    for name, body in rendered.items():
+        if name == "NOTES.txt":
+            continue
+        if not first:
+            print("---")
+        first = False
+        print(f"# Source: {name}")
+        print(body.strip("\n"))
+    return 0
+
+
 def cmd_version(args: argparse.Namespace) -> int:
     print(f"{buildinfo.APP_NAME} {buildinfo.VERSION}")
     return 0
@@ -546,6 +571,16 @@ def build_parser() -> argparse.ArgumentParser:
     rl.add_argument("--addr", default="127.0.0.1:4245")
     rl.add_argument("--name", default="relay")
     rl.set_defaults(fn=cmd_relay)
+
+    dp = sub.add_parser("deploy", help="deployment helpers")
+    dsub = dp.add_subparsers(dest="deploy_cmd", required=True)
+    dr = dsub.add_parser("render", help="render the helm chart (no helm needed)")
+    dr.add_argument("--chart", default="deploy/helm/retina-tpu")
+    dr.add_argument("--release", default="retina-tpu")
+    dr.add_argument("--namespace", default=None)
+    dr.add_argument("--values", action="append", metavar="FILE")
+    dr.add_argument("--set", action="append", metavar="key=val")
+    dr.set_defaults(fn=cmd_deploy_render)
 
     v = sub.add_parser("version")
     v.set_defaults(fn=cmd_version)

@@ -1,0 +1,197 @@
+"""Helm chart render tests (reference analog: the reference's chart under
+deploy/standard/.../helm/retina/templates, validated by its e2e install).
+
+Rendered through retina_tpu.utils.helmlite — the same engine the CLI's
+``deploy render`` uses — so these tests pin both the chart AND the
+renderer subset it restricts itself to."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+import yaml
+
+from retina_tpu.config import Config
+from retina_tpu.utils.helmlite import (
+    HelmliteError,
+    render,
+    render_chart,
+    render_chart_docs,
+)
+
+CHART = os.path.join(os.path.dirname(__file__), "..", "deploy", "helm",
+                     "retina-tpu")
+
+
+def by_kind(docs, kind):
+    return [d for d in docs if d["kind"] == kind]
+
+
+def named(docs, kind, name):
+    (doc,) = [d for d in by_kind(docs, kind)
+              if d["metadata"]["name"] == name]
+    return doc
+
+
+class TestRendererSubset:
+    def test_substitution_and_trim(self):
+        ctx = {"Values": {"a": {"b": 7}}}
+        assert render("x: {{ .Values.a.b }}", ctx) == "x: 7"
+        assert render("a\n{{- if .Values.a }}\nb\n{{- end }}\n", ctx) == "a\nb\n"
+        assert render("a\n{{- if .Values.missing }}\nb\n{{- end }}\n", ctx) == "a\n"
+
+    def test_pipeline_functions(self):
+        ctx = {"Values": {"l": ["x", "y"], "p": 99, "e": ""}}
+        assert render("{{ .Values.p | quote }}", ctx) == '"99"'
+        assert render("{{ .Values.l | toYaml }}", ctx) == "- x\n- y"
+        assert render("{{ .Values.l | toYaml | indent 2 }}", ctx) == "  - x\n  - y"
+        assert render("{{ .Values.e | default \"d\" }}", ctx) == "d"
+
+    def test_else_branch(self):
+        ctx = {"Values": {"on": False}}
+        out = render("{{- if .Values.on }}A{{- else }}B{{- end }}", ctx)
+        assert out == "B"
+
+    def test_unsupported_function_raises(self):
+        with pytest.raises(HelmliteError):
+            render("{{ .Values.x | upper }}", {"Values": {"x": "a"}})
+
+    def test_booleans_render_go_style(self):
+        ctx = {"Values": {"t": True, "f": False}}
+        assert render("{{ .Values.t }}/{{ .Values.f }}", ctx) == "true/false"
+
+
+class TestChartDefaults:
+    def test_renders_all_expected_kinds(self):
+        docs = render_chart_docs(CHART)
+        kinds = {(d["kind"], d["metadata"]["name"]) for d in docs}
+        assert ("DaemonSet", "retina-tpu-agent") in kinds
+        assert ("Deployment", "retina-tpu-operator") in kinds
+        assert ("Deployment", "retina-tpu-relay") in kinds
+        assert ("Service", "retina-tpu-peer") in kinds
+        assert ("ConfigMap", "retina-tpu-config") in kinds
+        # CRDs ship via the operator's --install-crds by default
+        assert not by_kind(docs, "CustomResourceDefinition")
+
+    def test_configmap_keys_are_real_config_fields(self):
+        docs = render_chart_docs(CHART)
+        cm = named(docs, "ConfigMap", "retina-tpu-config")
+        conf = yaml.safe_load(cm["data"]["config.yaml"])
+        valid = {f.name for f in Config.__dataclass_fields__.values()}
+        unknown = set(conf) - valid
+        assert not unknown, f"configmap keys not in Config: {unknown}"
+        # And the rendered config actually validates.
+        cfg = Config()
+        for k, v in conf.items():
+            setattr(cfg, k, v)
+        cfg.validate()
+
+    def test_daemonset_wiring(self):
+        docs = render_chart_docs(CHART)
+        ds = named(docs, "DaemonSet", "retina-tpu-agent")
+        spec = ds["spec"]["template"]["spec"]
+        c = spec["containers"][0]
+        assert c["image"] == "retina-tpu:latest"
+        port_names = {p["name"] for p in c["ports"]}
+        assert {"metrics", "hubble", "hubble-metrics"} <= port_names
+        assert c["livenessProbe"]["httpGet"]["port"] == 10093
+        assert spec["serviceAccountName"] == "retina-tpu-agent"
+        assert {v["name"] for v in spec["volumes"]} == {
+            "config", "state", "xla-cache"
+        }
+        # TPU scheduling: node selector + toleration + chip limit
+        assert "cloud.google.com/gke-tpu-accelerator" in spec["nodeSelector"]
+        assert c["resources"]["limits"]["google.com/tpu"] == "1"
+
+    def test_operator_leader_election_args(self):
+        docs = render_chart_docs(CHART)
+        op = named(docs, "Deployment", "retina-tpu-operator")
+        args = op["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--leader-elect" in args and "--install-crds" in args
+        assert op["spec"]["replicas"] == 2
+
+    def test_rbac_matches_raw_manifest_coverage(self):
+        docs = render_chart_docs(CHART)
+        roles = {d["metadata"]["name"] for d in by_kind(docs, "ClusterRole")}
+        assert roles == {"retina-tpu-agent", "retina-tpu-operator"}
+        op = named(docs, "ClusterRole", "retina-tpu-operator")
+        leases = [r for r in op["rules"]
+                  if "coordination.k8s.io" in r["apiGroups"]]
+        assert leases and "create" in leases[0]["verbs"]
+
+
+class TestChartValueToggles:
+    def test_hubble_disabled_drops_ports_and_services(self):
+        docs = render_chart_docs(
+            CHART,
+            set_values=["hubble.enabled=false", "relay.enabled=false"],
+        )
+        ds = named(docs, "DaemonSet", "retina-tpu-agent")
+        port_names = {
+            p["name"]
+            for p in ds["spec"]["template"]["spec"]["containers"][0]["ports"]
+        }
+        assert port_names == {"metrics"}
+        assert not [d for d in by_kind(docs, "Service")]
+        assert not [d for d in by_kind(docs, "Deployment")
+                    if d["metadata"]["name"] == "retina-tpu-relay"]
+        cm = named(docs, "ConfigMap", "retina-tpu-config")
+        conf = yaml.safe_load(cm["data"]["config.yaml"])
+        assert conf["enable_hubble"] is False
+        assert "hubble_addr" not in conf
+
+    def test_operator_disabled(self):
+        docs = render_chart_docs(CHART, set_values=["operator.enabled=false"])
+        assert not [d for d in by_kind(docs, "Deployment")
+                    if d["metadata"]["name"] == "retina-tpu-operator"]
+        sas = {d["metadata"]["name"] for d in by_kind(docs, "ServiceAccount")}
+        assert sas == {"retina-tpu-agent"}
+
+    def test_crds_install_toggle_matches_generator(self):
+        from retina_tpu.operator.crdinstall import crd_manifests
+
+        docs = render_chart_docs(CHART, set_values=["crds.install=true"])
+        crds = by_kind(docs, "CustomResourceDefinition")
+        assert {d["spec"]["names"]["plural"] for d in crds} == {
+            d["spec"]["names"]["plural"] for d in crd_manifests()
+        }
+
+    def test_image_and_replica_overrides(self):
+        docs = render_chart_docs(
+            CHART,
+            set_values=[
+                "image.repository=ghcr.io/example/retina-tpu",
+                "image.tag=v9.9.9",
+                "operator.replicas=3",
+            ],
+        )
+        ds = named(docs, "DaemonSet", "retina-tpu-agent")
+        img = ds["spec"]["template"]["spec"]["containers"][0]["image"]
+        assert img == "ghcr.io/example/retina-tpu:v9.9.9"
+        op = named(docs, "Deployment", "retina-tpu-operator")
+        assert op["spec"]["replicas"] == 3
+
+    def test_release_name_and_namespace_flow_through(self):
+        docs = render_chart_docs(
+            CHART, release_name="obs", namespace="monitoring"
+        )
+        ds = named(docs, "DaemonSet", "obs-agent")
+        assert ds["metadata"]["namespace"] == "monitoring"
+        vols = ds["spec"]["template"]["spec"]["volumes"]
+        (cfgvol,) = [v for v in vols if v["name"] == "config"]
+        assert cfgvol["configMap"]["name"] == "obs-config"
+
+
+def test_cli_deploy_render(capsys):
+    from retina_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["deploy", "render", "--chart", CHART, "--set",
+         "operator.replicas=5"]
+    )
+    assert args.fn(args) == 0
+    out = capsys.readouterr().out
+    docs = [d for d in yaml.safe_load_all(out) if d]
+    op = named(docs, "Deployment", "retina-tpu-operator")
+    assert op["spec"]["replicas"] == 5
