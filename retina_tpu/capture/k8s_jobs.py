@@ -41,17 +41,23 @@ def job_manifest(job: CaptureJob, image: str = DEFAULT_IMAGE,
                  run_id: str = "") -> dict:
     """CaptureJob → batch/v1 Job dict (initJobTemplate analog):
     host-network pod pinned to the node, NET_ADMIN/SYS_ADMIN only,
-    backoffLimit 0, tiny resource envelope, hostPath output mount.
+    backoffLimit 0, tiny resource envelope. hostPath outputs mount the
+    node directory; blob/S3 outputs pass straight through to the in-Job
+    workload, which uploads over REST (capture/remote.py) — matching the
+    reference's blob.go/s3.go upload-from-the-capture-pod flow.
 
     Raises ValueError for outputs the in-Job workload cannot express
-    yet (PVC/blob/s3 without a hostPath) — a clear reconcile failure
-    beats an argparse crash inside the pod."""
-    host_path = (job.output or {}).get("host_path", "")
-    if not host_path:
+    (PVC-only without a hostPath) — a clear reconcile failure beats an
+    argparse crash inside the pod."""
+    out = job.output or {}
+    host_path = out.get("host_path", "")
+    blob_url = out.get("blob_upload_secret", "")
+    s3 = out.get("s3_upload") or {}
+    if not (host_path or blob_url or s3):
         raise ValueError(
-            "remote capture jobs currently require a hostPath output "
-            "(PVC/blob/s3-only outputs are not expressible by the "
-            "in-job capture workload)"
+            "remote capture jobs need a hostPath, blob, or s3 output "
+            "(PVC-only outputs are not expressible by the in-job "
+            "capture workload)"
         )
     args = [
         "capture", "create",
@@ -60,8 +66,35 @@ def job_manifest(job: CaptureJob, image: str = DEFAULT_IMAGE,
         "--node-names", job.node_name,
         "--duration", str(job.duration_s),
         "--max-size", str(job.max_size_mb),
-        "--host-path", host_path,
     ]
+    env = []
+    env_from = []
+    if host_path:
+        args += ["--host-path", host_path]
+    if blob_url:
+        # blob_upload_secret names a Kubernetes Secret (reference
+        # contract: secret "capture-blob-upload-secret", key
+        # "blob-upload-url", job_specification.go:23-27). The SAS URL is
+        # a bearer credential — it must reach the pod via the Secret,
+        # NEVER in plain-text container args.
+        env.append({
+            "name": "BLOB_URL",
+            "valueFrom": {"secretKeyRef": {
+                "name": blob_url, "key": "blob-upload-url",
+            }},
+        })
+    if s3:
+        args += ["--s3-bucket", s3.get("bucket", ""),
+                 "--s3-region", s3.get("region", "")]
+        if s3.get("key_prefix"):
+            args += ["--s3-prefix", s3["key_prefix"]]
+        if s3.get("endpoint"):
+            args += ["--s3-endpoint", s3["endpoint"]]
+        # AWS credentials come from a Secret carrying the standard env
+        # names (AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY[/SESSION_TOKEN]).
+        env_from.append({"secretRef": {
+            "name": s3.get("secret_name", "capture-s3-upload-secret"),
+        }})
     if job.filter_expr:
         args += ["--filter", job.filter_expr]
     if job.packet_size_bytes:
@@ -73,6 +106,8 @@ def job_manifest(job: CaptureJob, image: str = DEFAULT_IMAGE,
         "image": image,
         "imagePullPolicy": "IfNotPresent",
         "args": args,
+        **({"env": env} if env else {}),
+        **({"envFrom": env_from} if env_from else {}),
         "securityContext": {
             "capabilities": {"add": ["NET_ADMIN", "SYS_ADMIN"]},
         },
@@ -89,13 +124,14 @@ def job_manifest(job: CaptureJob, image: str = DEFAULT_IMAGE,
         "tolerations": [{"operator": "Exists"}],
         "containers": [container],
     }
-    spec["volumes"] = [{
-        "name": "capture-output",
-        "hostPath": {"path": host_path, "type": "DirectoryOrCreate"},
-    }]
-    container["volumeMounts"] = [{
-        "name": "capture-output", "mountPath": host_path,
-    }]
+    if host_path:
+        spec["volumes"] = [{
+            "name": "capture-output",
+            "hostPath": {"path": host_path, "type": "DirectoryOrCreate"},
+        }]
+        container["volumeMounts"] = [{
+            "name": "capture-output", "mountPath": host_path,
+        }]
     # DNS-1123 safety: truncate the base, never the uniqueness suffix,
     # and never leave a trailing '-'.
     base = f"{job.capture_name}-{job.node_name}"[:56].rstrip("-.")
@@ -178,8 +214,21 @@ class KubeJobRunner:
                     ) from e
                 st = {}
             if st.get("succeeded"):
-                host_path = (job.output or {}).get("host_path", "")
-                return [f"node://{job.node_name}{host_path}"]
+                out = job.output or {}
+                hints = []
+                if out.get("host_path"):
+                    hints.append(
+                        f"node://{job.node_name}{out['host_path']}"
+                    )
+                if out.get("blob_upload_secret"):
+                    hints.append("blob://(container SAS)")
+                s3 = out.get("s3_upload") or {}
+                if s3.get("bucket"):
+                    hints.append(
+                        f"s3://{s3['bucket']}/"
+                        f"{s3.get('key_prefix', 'retina/captures')}"
+                    )
+                return hints
             if st.get("failed"):
                 raise RuntimeError(
                     f"capture job {name} failed on {job.node_name}"

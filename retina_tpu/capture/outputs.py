@@ -2,11 +2,11 @@
 
 Reference analog: pkg/capture/outputlocation/ — hostPath (hostpath.go),
 PVC (pvc.go), Azure blob SAS upload (blob.go), S3 (s3.go). Every location
-implements {Name, Enabled, Output(srcFile)}. Blob/S3 need cloud SDKs +
-credentials with network egress — both are implemented against the same
-interface but report unavailable in this environment (Enabled() false
-unless their SDK + creds exist), exactly how the reference disables
-locations that aren't configured.
+implements {Name, Enabled, Output(srcFile)}. Blob/S3 speak the storage
+REST APIs directly (capture/remote.py) instead of requiring cloud SDKs,
+so Enabled() depends only on configuration (SAS URL present; bucket +
+AWS env credentials present) — and the upload paths run under test
+against a fake storage server (tests/test_capture_remote.py).
 """
 
 from __future__ import annotations
@@ -50,7 +50,8 @@ class PvcOutput(HostPathOutput):
 
 
 class BlobOutput:
-    """outputlocation/blob.go — Azure blob SAS-URL upload."""
+    """outputlocation/blob.go — Azure blob container-SAS upload, spoken
+    as plain REST (capture/remote.py) so no SDK gate exists."""
 
     name = "blob"
 
@@ -60,51 +61,58 @@ class BlobOutput:
     def enabled(self) -> bool:
         if not self.sas_url:
             return False
-        try:
-            import azure.storage.blob  # noqa: F401
-
-            return True
-        except ImportError:
-            _log.warning("blob output configured but azure SDK unavailable")
+        if not self.sas_url.startswith(("http://", "https://")):
+            # In-cluster specs carry a Secret NAME here; the Job injects
+            # the actual SAS URL as BLOB_URL env (k8s_jobs.job_manifest)
+            # and the workload passes it through. A bare name reaching
+            # this point means no resolution happened — disable loudly
+            # rather than dial a secret name as a URL.
+            _log.warning(
+                "blob output %r is not a URL (unresolved secret name?); "
+                "disabled", self.sas_url,
+            )
             return False
+        return True
 
-    def output(self, src_file: str) -> str:  # pragma: no cover - needs SDK
-        from azure.storage.blob import BlobClient
+    def output(self, src_file: str) -> str:
+        from retina_tpu.capture.remote import BlobStore
 
-        blob = BlobClient.from_blob_url(self.sas_url)
-        with open(src_file, "rb") as fh:
-            blob.upload_blob(fh, overwrite=True)
-        return self.sas_url
+        url = BlobStore(self.sas_url).upload(
+            os.path.basename(src_file), src_file
+        )
+        _log.info("capture artifact uploaded: %s", url)
+        return url
 
 
 class S3Output:
-    """outputlocation/s3.go — S3 PutObject upload."""
+    """outputlocation/s3.go — S3 PutObject upload via SigV4 REST
+    (capture/remote.py); credentials from the standard AWS env."""
 
     name = "s3"
 
     def __init__(self, bucket: str = "", region: str = "",
-                 key_prefix: str = "retina/captures"):
+                 key_prefix: str = "retina/captures", endpoint: str = ""):
         self.bucket, self.region, self.key_prefix = bucket, region, key_prefix
+        self.endpoint = endpoint
+
+    def _store(self):
+        from retina_tpu.capture.remote import S3Store
+
+        return S3Store(self.bucket, self.region, endpoint=self.endpoint)
 
     def enabled(self) -> bool:
         if not self.bucket:
             return False
-        try:
-            import boto3  # noqa: F401
-
-            return True
-        except ImportError:
-            _log.warning("s3 output configured but boto3 unavailable")
+        if not self._store().credentialed():
+            _log.warning("s3 output configured but AWS credentials missing")
             return False
+        return True
 
-    def output(self, src_file: str) -> str:  # pragma: no cover - needs SDK
-        import boto3
-
+    def output(self, src_file: str) -> str:
         key = f"{self.key_prefix}/{os.path.basename(src_file)}"
-        boto3.client("s3", region_name=self.region).upload_file(
-            src_file, self.bucket, key
-        )
-        return f"s3://{self.bucket}/{key}"
+        url = self._store().upload(key, src_file)
+        _log.info("capture artifact uploaded: %s", url)
+        return url
 
 
 def outputs_from_spec(output: dict) -> list:
@@ -115,7 +123,7 @@ def outputs_from_spec(output: dict) -> list:
         BlobOutput(output.get("blob_upload_secret", "")),
         S3Output(**{
             k: v for k, v in (output.get("s3_upload") or {}).items()
-            if k in ("bucket", "region", "key_prefix")
+            if k in ("bucket", "region", "key_prefix", "endpoint")
         }),
     ]
     return [s for s in sinks if s.enabled()]

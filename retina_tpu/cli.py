@@ -217,7 +217,26 @@ def cmd_capture_create(args: argparse.Namespace) -> int:
         namespace=args.namespace,
         spec=CaptureSpec(
             target=CaptureTarget(node_names=args.node_names or ["local"]),
-            output=CaptureOutput(host_path=args.host_path),
+            output=CaptureOutput(
+                host_path=args.host_path,
+                # In-cluster capture Jobs deliver the SAS URL through a
+                # Secret-injected BLOB_URL env (k8s_jobs.job_manifest);
+                # direct invocations may pass --blob-url.
+                blob_upload_secret=(
+                    args.blob_url or os.environ.get("BLOB_URL", "")
+                ),
+                s3_upload=(
+                    {
+                        "bucket": args.s3_bucket,
+                        "region": args.s3_region,
+                        **({"key_prefix": args.s3_prefix}
+                           if args.s3_prefix else {}),
+                        **({"endpoint": args.s3_endpoint}
+                           if args.s3_endpoint else {}),
+                    }
+                    if args.s3_bucket else {}
+                ),
+            ),
             duration_s=args.duration,
             max_capture_size_mb=args.max_size,
             packet_size_bytes=args.packet_size,
@@ -241,7 +260,47 @@ def cmd_capture_create(args: argparse.Namespace) -> int:
     return rc
 
 
+def _capture_store(args: argparse.Namespace):
+    """Resolve the artifact store the list/download/delete verbs act on.
+
+    Precedence: explicit --blob-url, then explicit --s3-bucket, then
+    explicit --host-path (local), then the BLOB_URL env (the reference's
+    download contract, cli/cmd/capture/download.go:19). An explicit flag
+    always beats ambient environment.
+
+    Raises SystemExit-style by returning (None, False) when no location
+    was given at all — callers must NOT fall back to a relative local
+    path (deleting ./<file> because an env var was unset is how files
+    get lost)."""
+    if getattr(args, "blob_url", ""):
+        from retina_tpu.capture.remote import BlobStore
+
+        return BlobStore(args.blob_url), True
+    if getattr(args, "s3_bucket", ""):
+        from retina_tpu.capture.remote import S3Store
+
+        return S3Store(args.s3_bucket, args.s3_region,
+                       endpoint=args.s3_endpoint or ""), True
+    if args.host_path:
+        return None, True  # explicit local store
+    env_url = os.environ.get("BLOB_URL", "")
+    if env_url:
+        from retina_tpu.capture.remote import BlobStore
+
+        return BlobStore(env_url), True
+    print("no capture location: pass --host-path, --blob-url, "
+          "--s3-bucket, or set BLOB_URL", file=sys.stderr)
+    return None, False
+
+
 def cmd_capture_list(args: argparse.Namespace) -> int:
+    store, ok = _capture_store(args)
+    if not ok:
+        return 2
+    if store is not None:
+        for a in store.list(prefix=getattr(args, "prefix", "") or ""):
+            print(f"{a.name}\t{a.size}\t{a.last_modified}")
+        return 0
     if not os.path.isdir(args.host_path):
         print("no captures found")
         return 0
@@ -255,6 +314,26 @@ def cmd_capture_list(args: argparse.Namespace) -> int:
 def cmd_capture_download(args: argparse.Namespace) -> int:
     import shutil
 
+    store, ok = _capture_store(args)
+    if not ok:
+        return 2
+    if store is not None:
+        # Prefix semantics like the reference: download every artifact
+        # whose name starts with the given name (multi-node captures
+        # produce one tarball per node).
+        matches = [a for a in store.list(prefix=args.file)]
+        if not matches:
+            print(f"no remote artifacts match: {args.file}",
+                  file=sys.stderr)
+            return 1
+        out_dir = args.output
+        os.makedirs(out_dir, exist_ok=True)
+        for a in matches:
+            dst = store.download(
+                a.name, os.path.join(out_dir, os.path.basename(a.name))
+            )
+            print(dst)
+        return 0
     src = os.path.join(args.host_path, args.file)
     if not os.path.exists(src):
         print(f"not found: {src}", file=sys.stderr)
@@ -265,6 +344,19 @@ def cmd_capture_download(args: argparse.Namespace) -> int:
 
 
 def cmd_capture_delete(args: argparse.Namespace) -> int:
+    store, ok = _capture_store(args)
+    if not ok:
+        return 2
+    if store is not None:
+        matches = [a for a in store.list(prefix=args.file)]
+        if not matches:
+            print(f"no remote artifacts match: {args.file}",
+                  file=sys.stderr)
+            return 1
+        for a in matches:
+            store.delete(a.name)
+            print(f"deleted {a.name}")
+        return 0
     src = os.path.join(args.host_path, args.file)
     try:
         os.unlink(src)
@@ -491,11 +583,27 @@ def build_parser() -> argparse.ArgumentParser:
 
     cap = sub.add_parser("capture", help="packet captures")
     csub = cap.add_subparsers(dest="capture_cmd", required=True)
+
+    def remote_args(sp, with_s3: bool = True):
+        sp.add_argument("--blob-url", default="",
+                        help="blob container SAS URL (or BLOB_URL env)")
+        if with_s3:
+            sp.add_argument("--s3-bucket", default="")
+            sp.add_argument("--s3-region", default="")
+            sp.add_argument("--s3-prefix", default="",
+                            help="object key prefix (default "
+                                 "retina/captures)")
+            sp.add_argument("--s3-endpoint", default="",
+                            help="endpoint override for S3-compatible "
+                                 "stores")
+
     cc = csub.add_parser("create")
     cc.add_argument("--name", required=True)
     cc.add_argument("--namespace", default="default")
     cc.add_argument("--node-names", nargs="*", default=None)
-    cc.add_argument("--host-path", required=True)
+    cc.add_argument("--host-path", default="",
+                    help="local artifact directory (omit for remote-"
+                         "only outputs)")
     cc.add_argument("--duration", type=int, default=10)
     cc.add_argument("--max-size", type=int, default=100)
     cc.add_argument("--filter", default="")
@@ -503,18 +611,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="snap length in bytes (0 = full packets)")
     cc.add_argument("--no-metadata", action="store_true",
                     help="skip the network-state metadata dumps")
+    remote_args(cc)
     cc.set_defaults(fn=cmd_capture_create)
     cl = csub.add_parser("list")
-    cl.add_argument("--host-path", required=True)
+    cl.add_argument("--host-path", default="")
+    cl.add_argument("--prefix", default="")
+    remote_args(cl)
     cl.set_defaults(fn=cmd_capture_list)
     cd = csub.add_parser("download")
-    cd.add_argument("--host-path", required=True)
-    cd.add_argument("--file", required=True)
+    cd.add_argument("--host-path", default="")
+    cd.add_argument("--file", required=True,
+                    help="artifact name (remote stores: name prefix)")
     cd.add_argument("--output", default=".")
+    remote_args(cd)
     cd.set_defaults(fn=cmd_capture_download)
     cx = csub.add_parser("delete")
-    cx.add_argument("--host-path", required=True)
-    cx.add_argument("--file", required=True)
+    cx.add_argument("--host-path", default="")
+    cx.add_argument("--file", required=True,
+                    help="artifact name (remote stores: name prefix)")
+    remote_args(cx)
     cx.set_defaults(fn=cmd_capture_delete)
 
     ob = sub.add_parser("observe", help="stream flows from the relay")
