@@ -385,9 +385,37 @@ def main() -> None:
                     help="full-system bench only (agent boot -> scrape)")
     ap.add_argument("--no-e2e", action="store_true",
                     help="skip the e2e phase of the default run")
+    ap.add_argument("--perf", action="store_true",
+                    help="agent-overhead regression harness (loopback "
+                         "workload with vs without the live agent)")
     args = ap.parse_args()
     try:
-        if args.e2e:
+        if args.perf:
+            from retina_tpu.config import (
+                DEFAULT_CACHE_DIR, enable_compilation_cache,
+            )
+            from retina_tpu.e2e.perf import (
+                default_agent_factory, run_regression,
+            )
+
+            enable_compilation_cache(DEFAULT_CACHE_DIR)
+            res = run_regression(
+                duration_s=5.0 if args.smoke else 15.0,
+                agent_factory=default_agent_factory,
+            )
+            reg = res.get("regression", {})
+            out = {
+                "metric": "agent_throughput_regression_pct",
+                "value": reg.get("throughput_pct", 0.0),
+                "unit": "percent",
+                # North star is "minimal overhead"; report vs a 5%
+                # budget like the reference's regression gate.
+                "vs_baseline": round(
+                    reg.get("throughput_pct", 0.0) / 5.0, 4
+                ),
+                "extra": res,
+            }
+        elif args.e2e:
             e2e = run_e2e(args.smoke)
             out = {
                 "metric": "flow_events_per_sec_e2e",

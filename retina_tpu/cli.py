@@ -245,7 +245,13 @@ def cmd_capture_create(args: argparse.Namespace) -> int:
         ),
     )
     nodes = [RetinaNode(name=n) for n in (args.node_names or ["local"])]
-    jobs = translate_capture_to_jobs(cap, nodes, [])
+    from retina_tpu.crd.types import ValidationError
+
+    try:
+        jobs = translate_capture_to_jobs(cap, nodes, [])
+    except ValidationError as e:
+        print(f"invalid capture: {e}", file=sys.stderr)
+        return 2
     mgr = CaptureManager()
     rc = 0
     for job in jobs:
@@ -275,32 +281,47 @@ def _capture_store(args: argparse.Namespace):
     if getattr(args, "blob_url", ""):
         from retina_tpu.capture.remote import BlobStore
 
-        return BlobStore(args.blob_url), True
+        return BlobStore(args.blob_url), "", True
     if getattr(args, "s3_bucket", ""):
         from retina_tpu.capture.remote import S3Store
 
-        return S3Store(args.s3_bucket, args.s3_region,
-                       endpoint=args.s3_endpoint or ""), True
+        # S3 uploads key artifacts under a prefix (default
+        # retina/captures, outputs.py) — compose it into every match so
+        # `--file capture-x` round-trips with what create stored.
+        root = (getattr(args, "s3_prefix", "") or "retina/captures")
+        return (
+            S3Store(args.s3_bucket, args.s3_region,
+                    endpoint=args.s3_endpoint or ""),
+            root.rstrip("/") + "/",
+            True,
+        )
     if args.host_path:
-        return None, True  # explicit local store
+        return None, "", True  # explicit local store
     env_url = os.environ.get("BLOB_URL", "")
     if env_url:
         from retina_tpu.capture.remote import BlobStore
 
-        return BlobStore(env_url), True
+        return BlobStore(env_url), "", True
     print("no capture location: pass --host-path, --blob-url, "
           "--s3-bucket, or set BLOB_URL", file=sys.stderr)
-    return None, False
+    return None, "", False
 
 
 def cmd_capture_list(args: argparse.Namespace) -> int:
-    store, ok = _capture_store(args)
-    if not ok:
-        return 2
-    if store is not None:
-        for a in store.list(prefix=getattr(args, "prefix", "") or ""):
-            print(f"{a.name}\t{a.size}\t{a.last_modified}")
-        return 0
+    from retina_tpu.capture.remote import RemoteStoreError
+
+    try:
+        store, root, ok = _capture_store(args)
+        if not ok:
+            return 2
+        if store is not None:
+            prefix = root + (getattr(args, "prefix", "") or "")
+            for a in store.list(prefix=prefix):
+                print(f"{a.name}\t{a.size}\t{a.last_modified}")
+            return 0
+    except (RemoteStoreError, ValueError) as e:
+        print(f"capture list failed: {e}", file=sys.stderr)
+        return 1
     if not os.path.isdir(args.host_path):
         print("no captures found")
         return 0
@@ -314,26 +335,33 @@ def cmd_capture_list(args: argparse.Namespace) -> int:
 def cmd_capture_download(args: argparse.Namespace) -> int:
     import shutil
 
-    store, ok = _capture_store(args)
-    if not ok:
-        return 2
-    if store is not None:
-        # Prefix semantics like the reference: download every artifact
-        # whose name starts with the given name (multi-node captures
-        # produce one tarball per node).
-        matches = [a for a in store.list(prefix=args.file)]
-        if not matches:
-            print(f"no remote artifacts match: {args.file}",
-                  file=sys.stderr)
-            return 1
-        out_dir = args.output
-        os.makedirs(out_dir, exist_ok=True)
-        for a in matches:
-            dst = store.download(
-                a.name, os.path.join(out_dir, os.path.basename(a.name))
-            )
-            print(dst)
-        return 0
+    from retina_tpu.capture.remote import RemoteStoreError
+
+    try:
+        store, root, ok = _capture_store(args)
+        if not ok:
+            return 2
+        if store is not None:
+            # Prefix semantics like the reference: download every
+            # artifact whose name starts with the given name (multi-node
+            # captures produce one tarball per node).
+            matches = [a for a in store.list(prefix=root + args.file)]
+            if not matches:
+                print(f"no remote artifacts match: {root}{args.file}",
+                      file=sys.stderr)
+                return 1
+            out_dir = args.output
+            os.makedirs(out_dir, exist_ok=True)
+            for a in matches:
+                dst = store.download(
+                    a.name,
+                    os.path.join(out_dir, os.path.basename(a.name)),
+                )
+                print(dst)
+            return 0
+    except (RemoteStoreError, ValueError) as e:
+        print(f"capture download failed: {e}", file=sys.stderr)
+        return 1
     src = os.path.join(args.host_path, args.file)
     if not os.path.exists(src):
         print(f"not found: {src}", file=sys.stderr)
@@ -344,19 +372,25 @@ def cmd_capture_download(args: argparse.Namespace) -> int:
 
 
 def cmd_capture_delete(args: argparse.Namespace) -> int:
-    store, ok = _capture_store(args)
-    if not ok:
-        return 2
-    if store is not None:
-        matches = [a for a in store.list(prefix=args.file)]
-        if not matches:
-            print(f"no remote artifacts match: {args.file}",
-                  file=sys.stderr)
-            return 1
-        for a in matches:
-            store.delete(a.name)
-            print(f"deleted {a.name}")
-        return 0
+    from retina_tpu.capture.remote import RemoteStoreError
+
+    try:
+        store, root, ok = _capture_store(args)
+        if not ok:
+            return 2
+        if store is not None:
+            matches = [a for a in store.list(prefix=root + args.file)]
+            if not matches:
+                print(f"no remote artifacts match: {root}{args.file}",
+                      file=sys.stderr)
+                return 1
+            for a in matches:
+                store.delete(a.name)
+                print(f"deleted {a.name}")
+            return 0
+    except (RemoteStoreError, ValueError) as e:
+        print(f"capture delete failed: {e}", file=sys.stderr)
+        return 1
     src = os.path.join(args.host_path, args.file)
     try:
         os.unlink(src)
