@@ -92,7 +92,10 @@ class S3Output:
 
     def __init__(self, bucket: str = "", region: str = "",
                  key_prefix: str = "retina/captures", endpoint: str = ""):
-        self.bucket, self.region, self.key_prefix = bucket, region, key_prefix
+        self.bucket, self.region = bucket, region
+        # Normalized: a user's trailing slash must not produce '//' keys
+        # that the CLI verbs' prefix matching can never find.
+        self.key_prefix = key_prefix.rstrip("/") or "retina/captures"
         self.endpoint = endpoint
 
     def _store(self):

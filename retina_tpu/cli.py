@@ -274,10 +274,12 @@ def _capture_store(args: argparse.Namespace):
     download contract, cli/cmd/capture/download.go:19). An explicit flag
     always beats ambient environment.
 
-    Raises SystemExit-style by returning (None, False) when no location
-    was given at all — callers must NOT fall back to a relative local
-    path (deleting ./<file> because an env var was unset is how files
-    get lost)."""
+    Returns (store, key_root, ok): ``store`` None means local hostPath;
+    ``key_root`` is the S3 key prefix the verbs must compose into (and
+    strip out of) artifact names; ``ok`` False means no location was
+    given at all — callers must NOT fall back to a relative local path
+    (deleting ./<file> because an env var was unset is how files get
+    lost)."""
     if getattr(args, "blob_url", ""):
         from retina_tpu.capture.remote import BlobStore
 
@@ -317,7 +319,12 @@ def cmd_capture_list(args: argparse.Namespace) -> int:
         if store is not None:
             prefix = root + (getattr(args, "prefix", "") or "")
             for a in store.list(prefix=prefix):
-                print(f"{a.name}\t{a.size}\t{a.last_modified}")
+                # Print names relative to the key root so a listed name
+                # pastes straight into download/delete --file (which
+                # re-compose the root).
+                name = a.name[len(root):] if a.name.startswith(root) \
+                    else a.name
+                print(f"{name}\t{a.size}\t{a.last_modified}")
             return 0
     except (RemoteStoreError, ValueError) as e:
         print(f"capture list failed: {e}", file=sys.stderr)

@@ -80,8 +80,13 @@ def run_workload(duration_s: float, payload: int = 1400) -> PerfResult:
     """One loopback UDP blast in a fresh process."""
     out = subprocess.run(
         [sys.executable, "-c", _WORKLOAD, str(duration_s), str(payload)],
-        capture_output=True, text=True, check=True, timeout=duration_s + 30,
+        capture_output=True, text=True, timeout=duration_s + 30,
     )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"perf workload exited {out.returncode}: "
+            f"{out.stderr.strip()[-500:]}"
+        )
     d = json.loads(out.stdout.strip().splitlines()[-1])
     return PerfResult(
         throughput_mbps=d["throughput_mbps"], pps=d["pps"],
@@ -177,6 +182,11 @@ def default_agent_factory(cfg_overrides: dict | None = None):
     t.start()
     deadline = time.monotonic() + 300
     while time.monotonic() < deadline:
+        if not t.is_alive():
+            # Boot crashed (e.g. AF_PACKET needs root): fail in <1s,
+            # not after a 5-minute poll.
+            raise RuntimeError("agent exited during perf-harness boot "
+                               "(live capture needs root/CAP_NET_RAW)")
         if d.cm.engine is not None and d.cm.engine.started.is_set():
             break
         time.sleep(0.2)

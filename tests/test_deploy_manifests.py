@@ -231,3 +231,23 @@ def test_grafana_dashboards_reference_real_metrics():
     finally:
         reset_exporter()
         reset_metrics()
+
+
+def test_ci_workflow_coherent():
+    """CI workflow (reference .github/workflows/test.yaml analog) parses
+    and references files/commands that exist in the repo."""
+    import yaml as _yaml
+
+    path = os.path.join(os.path.dirname(__file__), "..", ".github",
+                        "workflows", "test.yaml")
+    with open(path) as fh:
+        wf = _yaml.safe_load(fh)
+    assert set(wf["jobs"]) == {"unit", "bench-smoke", "manifests"}
+    steps = [s for j in wf["jobs"].values() for s in j["steps"]]
+    runs = "\n".join(s.get("run", "") for s in steps)
+    # Every file/target the workflow invokes exists.
+    root = os.path.join(os.path.dirname(__file__), "..")
+    assert os.path.exists(os.path.join(root, "retina_tpu/native/Makefile"))
+    assert os.path.exists(os.path.join(root, "bench.py"))
+    for t in ("tests/test_deploy_manifests.py", "tests/test_helm_chart.py"):
+        assert t in runs and os.path.exists(os.path.join(root, t))
