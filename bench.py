@@ -319,6 +319,23 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
 
     eng = d.cm.engine
     m = get_metrics()
+    # The measurement window must not open while the source is still
+    # compiling/pre-generating (plugin compile runs after the server is
+    # up; a cold XLA cache plus 2M-event pregen can take minutes): wait
+    # for the first real traffic to reach the engine.
+    tstart = time.monotonic()
+    while eng._events_in == 0:
+        if not t.is_alive():
+            raise RuntimeError(
+                "e2e: agent thread died during source startup"
+            )
+        if time.monotonic() - tstart > 300:
+            stop.set()
+            raise RuntimeError(
+                "e2e: no traffic from the synthetic source within 300s"
+            )
+        time.sleep(0.5)
+    log(f"e2e: first traffic after {time.monotonic() - tstart:.0f}s")
     time.sleep(warmup)
     ev0 = eng._events_in
     bytes0 = m.transfer_bytes._value.get()

@@ -37,6 +37,10 @@ class Config:
         default_factory=lambda: list(DEFAULT_PLUGINS)
     )
     metrics_interval_s: float = 10.0  # map-read plugin cadence
+    # /metrics render cache TTL (rendering tens of thousands of pod
+    # series is Python-heavy; gauges only change at publish cadence, so
+    # a sub-interval cache is lossless). 0 = render every scrape.
+    metrics_cache_ttl_s: float = 0.5
     enable_telemetry: bool = False
     enable_pod_level: bool = True
     remote_context: bool = False

@@ -133,6 +133,7 @@ class Daemon:
                 self.hubble_metrics_server = Server(
                     cfg.hubble_metrics_addr,
                     gather=get_exporter().gather_hubble_text,
+                    metrics_cache_ttl_s=cfg.metrics_cache_ttl_s,
                 )
         if cfg.enable_pod_level:
             dns_plugin = self.cm.pluginmanager.plugins.get("dns")

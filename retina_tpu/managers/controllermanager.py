@@ -84,6 +84,7 @@ class ControllerManager:
             self.cfg.api_server_addr,
             ready_check=self._ready.is_set,
             healthy_check=lambda: not self.pluginmanager.failed,
+            metrics_cache_ttl_s=self.cfg.metrics_cache_ttl_s,
         )
         self.server.expose_var("pods", self.cache.pod_count)
         self.server.expose_var("filter_ips", self.filtermanager.ip_count)

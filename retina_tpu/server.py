@@ -40,6 +40,7 @@ class Server:
         ready_check: Optional[Callable[[], bool]] = None,
         healthy_check: Optional[Callable[[], bool]] = None,
         gather: Optional[Callable[[], bytes]] = None,
+        metrics_cache_ttl_s: float = 0.5,
     ) -> None:
         host, _, port = addr.rpartition(":")
         self._host, self._port = host or "127.0.0.1", int(port)
@@ -50,6 +51,30 @@ class Server:
         self._vars: dict[str, Callable[[], object]] = {}
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        # Rendering ~50k pod-level series is Python-heavy (~0.5s at 2k
+        # pods); gauges only change at the metrics module's >=1s publish
+        # cadence, so a sub-publish-interval render cache is lossless
+        # and keeps scrape latency inside the <1s budget even under
+        # concurrent scrapers. 0 disables.
+        self._cache_ttl = metrics_cache_ttl_s
+        self._cache_lock = threading.Lock()
+        self._cache_body: bytes = b""
+        self._cache_time = 0.0
+
+    def _metrics_body(self) -> bytes:
+        if self._cache_ttl <= 0:
+            return self._gather()
+        # Single-flight: the render happens INSIDE the lock, so on TTL
+        # expiry one scraper rebuilds while concurrent scrapers wait for
+        # its body instead of all re-rendering 50k series in parallel.
+        with self._cache_lock:
+            now = time.monotonic()
+            if self._cache_body and now - self._cache_time < self._cache_ttl:
+                return self._cache_body
+            body = self._gather()
+            self._cache_body = body
+            self._cache_time = time.monotonic()
+            return body
 
     def expose_var(self, name: str, fn: Callable[[], object]) -> None:
         """Register a /debug/vars entry (expvar analog)."""
@@ -82,7 +107,7 @@ class Server:
                     if route == "/metrics":
                         self._send(
                             200,
-                            srv._gather(),
+                            srv._metrics_body(),
                             "text/plain; version=0.0.4; charset=utf-8",
                         )
                     elif route == "/healthz":

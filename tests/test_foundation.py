@@ -167,6 +167,42 @@ def test_server_endpoints():
         srv.stop()
 
 
+def test_metrics_render_cache():
+    """/metrics renders are cached inside the TTL (rendering ~50k pod
+    series is Python-heavy; gauges only change at publish cadence) and
+    re-render once the TTL lapses or when the TTL is 0."""
+    calls = {"n": 0}
+
+    def gather() -> bytes:
+        calls["n"] += 1
+        return b"cached_metric 1.0\n"
+
+    srv = Server("127.0.0.1:0", gather=gather, metrics_cache_ttl_s=60.0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        for _ in range(3):
+            assert b"cached_metric" in urllib.request.urlopen(
+                f"{base}/metrics").read()
+        assert calls["n"] == 1
+        srv._cache_time = 0.0  # expire
+        urllib.request.urlopen(f"{base}/metrics").read()
+        assert calls["n"] == 2
+    finally:
+        srv.stop()
+
+    calls["n"] = 0
+    srv = Server("127.0.0.1:0", gather=gather, metrics_cache_ttl_s=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        urllib.request.urlopen(f"{base}/metrics").read()
+        urllib.request.urlopen(f"{base}/metrics").read()
+        assert calls["n"] == 2
+    finally:
+        srv.stop()
+
+
 # --------------------------------------------------------------- common
 def test_retina_endpoint_and_dirtycache():
     ep = RetinaEndpoint(
