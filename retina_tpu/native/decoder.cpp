@@ -95,6 +95,80 @@ bool parse_dns(const uint8_t* data, size_t off, size_t end, uint32_t* qhash,
 
 extern "C" {
 
+// One Ethernet frame -> one 16-lane record (shared by the pcap decoder
+// and the TPACKET_V3 live ring reader, afpacket.cpp). Returns false for
+// frames outside the parse set (non-IPv4, non-TCP/UDP, truncated) —
+// exactly the packetparser.c parse() admission rule.
+bool rt_decode_eth_frame(const uint8_t* pkt, size_t caplen, uint64_t ts_ns,
+                         uint32_t obs_point, uint32_t direction,
+                         uint32_t* r) {
+  // --- Ethernet + IPv4 (packetparser.c parse() IPv4 block) ---
+  if (caplen < 14 + 20) return false;
+  if (be16(pkt + 12) != 0x0800) return false;
+  const uint8_t* ip = pkt + 14;
+  if ((ip[0] >> 4) != 4) return false;
+  size_t ihl = static_cast<size_t>(ip[0] & 0xF) * 4;
+  uint32_t proto = ip[9];
+  if (proto != kProtoTcp && proto != kProtoUdp) return false;
+  size_t l4_need = (proto == kProtoTcp) ? 20 : 8;
+  if (caplen < 14 + ihl + l4_need) return false;
+  const uint8_t* l4 = ip + ihl;
+
+  uint32_t sport = be16(l4), dport = be16(l4 + 2);
+  uint32_t tcp_flags = 0, tsval = 0, tsecr = 0;
+  if (proto == kProtoTcp) {
+    tcp_flags = l4[13];
+    size_t doff = static_cast<size_t>(l4[12] >> 4) * 4;
+    // --- TCP timestamp option walk (packetparser.c:42-115) ---
+    if (doff > 20 && caplen >= 14 + ihl + doff) {
+      const uint8_t* opt = l4 + 20;
+      size_t opt_len = doff - 20, p = 0;
+      while (p < opt_len) {
+        uint8_t kind = opt[p];
+        if (kind == 0) break;
+        if (kind == 1) { p += 1; continue; }
+        if (p + 1 >= opt_len) break;
+        uint8_t olen = opt[p + 1] < 2 ? 2 : opt[p + 1];
+        if (kind == 8 && p + 10 <= opt_len) {
+          tsval = be32(opt + p + 2);
+          tsecr = be32(opt + p + 6);
+          break;
+        }
+        p += olen;
+      }
+    }
+  }
+
+  std::memset(r, 0, NUM_FIELDS * sizeof(uint32_t));
+  r[TS_LO] = static_cast<uint32_t>(ts_ns);
+  r[TS_HI] = static_cast<uint32_t>(ts_ns >> 32);
+  r[SRC_IP] = be32(ip + 12);
+  r[DST_IP] = be32(ip + 16);
+  r[PORTS] = sport << 16 | dport;
+  r[META] = proto << 24 | tcp_flags << 16 | obs_point << 8 | direction << 4;
+  r[BYTES] = be16(ip + 2);
+  r[PACKETS] = 1;
+  r[VERDICT] = kVerdictForwarded;
+  r[TSVAL] = tsval;
+  r[TSECR] = tsecr;
+  r[EVENT_TYPE] = kEvForward;
+
+  // --- DNS (UDP :53) ---
+  if (proto == kProtoUdp && (sport == 53 || dport == 53)) {
+    size_t pay = 14 + ihl + 8;
+    uint32_t qhash, qtype, rcode;
+    bool is_resp;
+    if (caplen > pay &&
+        parse_dns(pkt, pay, caplen, &qhash, &qtype, &rcode, &is_resp)) {
+      r[DNS] = (qtype & 0xFFFFu) << 16 | (rcode & 0xFFu) << 8 |
+               (is_resp ? 2u : 1u);
+      r[DNS_QHASH] = qhash;
+      r[EVENT_TYPE] = is_resp ? kEvDnsResp : kEvDnsReq;
+    }
+  }
+  return true;
+}
+
 // Decode pcap bytes into out[max_records][NUM_FIELDS] (uint32).
 // Returns the number of decoded records (>= 0), or:
 //   -1  not a pcap; -2  out buffer too small (records written up to max).
@@ -128,75 +202,13 @@ long rt_decode_pcap(const uint8_t* data, size_t len, uint32_t obs_point,
     off += 16 + incl;
     (*n_packets_total)++;
 
-    // --- Ethernet + IPv4 (packetparser.c parse() IPv4 block) ---
-    if (caplen < 14 + 20) continue;
-    if (be16(pkt + 12) != 0x0800) continue;
-    const uint8_t* ip = pkt + 14;
-    if ((ip[0] >> 4) != 4) continue;
-    size_t ihl = static_cast<size_t>(ip[0] & 0xF) * 4;
-    uint32_t proto = ip[9];
-    if (proto != kProtoTcp && proto != kProtoUdp) continue;
-    size_t l4_need = (proto == kProtoTcp) ? 20 : 8;
-    if (caplen < 14 + ihl + l4_need) continue;
-    const uint8_t* l4 = ip + ihl;
-
-    uint32_t sport = be16(l4), dport = be16(l4 + 2);
-    uint32_t tcp_flags = 0, tsval = 0, tsecr = 0;
-    if (proto == kProtoTcp) {
-      tcp_flags = l4[13];
-      size_t doff = static_cast<size_t>(l4[12] >> 4) * 4;
-      // --- TCP timestamp option walk (packetparser.c:42-115) ---
-      if (doff > 20 && caplen >= 14 + ihl + doff) {
-        const uint8_t* opt = l4 + 20;
-        size_t opt_len = doff - 20, p = 0;
-        while (p < opt_len) {
-          uint8_t kind = opt[p];
-          if (kind == 0) break;
-          if (kind == 1) { p += 1; continue; }
-          if (p + 1 >= opt_len) break;
-          uint8_t olen = opt[p + 1] < 2 ? 2 : opt[p + 1];
-          if (kind == 8 && p + 10 <= opt_len) {
-            tsval = be32(opt + p + 2);
-            tsecr = be32(opt + p + 6);
-            break;
-          }
-          p += olen;
-        }
-      }
-    }
-
     if (n >= max_records) { overflow = true; break; }
-    uint32_t* r = out + n * NUM_FIELDS;
-    std::memset(r, 0, NUM_FIELDS * sizeof(uint32_t));
     uint64_t ts_ns = static_cast<uint64_t>(ts_sec) * 1000000000ull +
                      static_cast<uint64_t>(ts_frac) * (ns ? 1ull : 1000ull);
-    r[TS_LO] = static_cast<uint32_t>(ts_ns);
-    r[TS_HI] = static_cast<uint32_t>(ts_ns >> 32);
-    r[SRC_IP] = be32(ip + 12);
-    r[DST_IP] = be32(ip + 16);
-    r[PORTS] = sport << 16 | dport;
-    r[META] = proto << 24 | tcp_flags << 16 | obs_point << 8 | direction << 4;
-    r[BYTES] = be16(ip + 2);
-    r[PACKETS] = 1;
-    r[VERDICT] = kVerdictForwarded;
-    r[TSVAL] = tsval;
-    r[TSECR] = tsecr;
-    r[EVENT_TYPE] = kEvForward;
-
-    // --- DNS (UDP :53) ---
-    if (proto == kProtoUdp && (sport == 53 || dport == 53)) {
-      size_t pay = 14 + ihl + 8;
-      uint32_t qhash, qtype, rcode;
-      bool is_resp;
-      if (caplen > pay &&
-          parse_dns(pkt, pay, caplen, &qhash, &qtype, &rcode, &is_resp)) {
-        r[DNS] = (qtype & 0xFFFFu) << 16 | (rcode & 0xFFu) << 8 |
-                 (is_resp ? 2u : 1u);
-        r[DNS_QHASH] = qhash;
-        r[EVENT_TYPE] = is_resp ? kEvDnsResp : kEvDnsReq;
-      }
+    if (rt_decode_eth_frame(pkt, caplen, ts_ns, obs_point, direction,
+                            out + n * NUM_FIELDS)) {
+      n++;
     }
-    n++;
   }
   if (overflow) return -2;
   return static_cast<long>(n);

@@ -174,7 +174,54 @@ class PacketParserPlugin(Plugin):
             if self.cfg.synthetic_rate > 0:
                 stop.wait(len(block) / self.cfg.synthetic_rate)
 
+    def _run_live_native(self, stop: threading.Event) -> bool:
+        """TPACKET_V3 mmap ring capture (native/afpacket.cpp): the
+        kernel hands over whole blocks of frames and the C decoder
+        writes records directly — no per-packet syscall or Python cost.
+        Returns False when the ring is unavailable (no native lib /
+        capability) so the caller can fall back to the socket loop."""
+        from retina_tpu.events.schema import OP_FROM_NETWORK
+        from retina_tpu.native import AfPacketRing
+        from retina_tpu.sources.pcapdecode import dns_names_from_frames
+
+        try:
+            ring = AfPacketRing(
+                iface=self.cfg.capture_iface, obs_point=OP_FROM_NETWORK
+            )
+        except RuntimeError as e:
+            self.log.info("native AF_PACKET ring unavailable (%s); "
+                          "using socket loop", e)
+            return False
+        # The init()-opened raw socket would keep receiving (and the
+        # kernel keep cloning) every packet for the process lifetime —
+        # the ring replaces it entirely.
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+        self.log.info("live capture via TPACKET_V3 ring (iface=%r)",
+                      self.cfg.capture_iface or "all")
+        last_drops = 0
+        try:
+            while not stop.is_set():
+                rec, _seen, dns_frames = ring.poll(timeout_ms=100)
+                if len(rec):
+                    self.emit(rec)
+                if dns_frames:
+                    names = dns_names_from_frames(dns_frames)
+                    if names:
+                        self.dns_names.update(names)
+                        self._publish_dns_names(names)
+                drops = ring.drops()
+                if drops > last_drops:
+                    self.count_lost("kernel", drops - last_drops)
+                    last_drops = drops
+        finally:
+            ring.close()
+        return True
+
     def _run_live(self, stop: threading.Event) -> None:
+        if self._run_live_native(stop):
+            return
         from retina_tpu.sources.pcapdecode import synthesize_pcap, decode_pcap_bytes
 
         assert self._sock is not None

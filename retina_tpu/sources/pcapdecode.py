@@ -353,6 +353,35 @@ def _parse_dns(data: bytes, off: int, end: int):
     return b".".join(labels), qtype, rcode, is_resp
 
 
+def dns_names_from_frames(blob: bytes) -> dict[int, str]:
+    """qname strings from a [u16 caplen][eth frame] blob — the DNS
+    sidecar the native TPACKET_V3 ring emits (afpacket.cpp): the C path
+    fills record hash lanes, the host string table fills here."""
+    names: dict[int, str] = {}
+    off = 0
+    total = len(blob)
+    while off + 2 <= total:
+        (cl,) = struct.unpack_from("<H", blob, off)
+        off += 2
+        end = off + cl
+        if end > total:
+            break
+        frame = blob[off:off + cl]
+        off = end
+        if cl < 14 + 20 + 8 or frame[12] != 0x08 or frame[13] != 0x00:
+            continue
+        if (frame[14] >> 4) != 4 or frame[14 + 9] != PROTO_UDP:
+            continue
+        ihl = (frame[14] & 0xF) * 4
+        pay = 14 + ihl + 8
+        parsed = _parse_dns(frame, pay, cl)
+        if parsed is not None:
+            names[dns_qname_hash(parsed[0])] = parsed[0].decode(
+                "ascii", "replace"
+            )
+    return names
+
+
 def decode_pcap_file(path: str, **kw) -> PcapDecodeResult:
     with open(path, "rb") as fh:
         return decode_pcap_bytes(fh.read(), **kw)
