@@ -446,8 +446,12 @@ def main() -> None:
             if not args.no_e2e:
                 # Default run carries the system number alongside the
                 # device-step number so one JSON line captures both.
+                # Slightly shorter window than standalone --e2e keeps
+                # the combined run's wall clock bounded for the driver.
                 try:
-                    out["extra"]["e2e"] = run_e2e(args.smoke)
+                    out["extra"]["e2e"] = run_e2e(
+                        args.smoke, duration_s=8.0 if args.smoke else 25.0
+                    )
                 except Exception as e:  # noqa: BLE001
                     log("e2e phase FAILED:\n" + traceback.format_exc())
                     out["extra"]["e2e"] = {
