@@ -309,10 +309,23 @@ class Operator:
 
     def wait_capture(self, name: str, timeout: float = 120.0,
                      namespace: str = "default") -> None:
-        with self._jobs_lock:
-            t = self._jobs.get(f"{namespace}/{name}")
-        if t is not None:
-            t.join(timeout)
+        """Block until the capture's job thread finishes.
+
+        The apply -> watch -> reconcile hop is asynchronous, so the job
+        thread may not EXIST yet when a caller that just applied the CR
+        waits on it — poll for it up to the deadline instead of treating
+        absence as completion (that race intermittently returned before
+        the capture ran)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._jobs_lock:
+                t = self._jobs.get(f"{namespace}/{name}")
+            if t is not None:
+                t.join(max(0.0, deadline - time.monotonic()))
+                return
+            if time.monotonic() >= deadline:
+                return
+            time.sleep(0.02)
 
     # -- config reconciles ---------------------------------------------
     def _on_metrics_conf(self, event: str, conf: MetricsConfiguration) -> None:
