@@ -258,7 +258,15 @@ class S3Store:
         headers = self._sign(method, enc_path, query_pairs, payload_hash, now)
         if content_length is not None:
             headers["Content-Length"] = str(content_length)
-        qs = urllib.parse.urlencode(query_pairs)
+        # Same percent-encoding as the canonical query in _sign (space ->
+        # %20, never '+'): SigV4 servers recompute the canonical string
+        # from the bytes on the wire, so urlencode's quote_plus would
+        # break the signature for any key/prefix/token with a space.
+        qs = "&".join(
+            f"{urllib.parse.quote(k, safe='')}="
+            f"{urllib.parse.quote(v, safe='')}"
+            for k, v in query_pairs
+        )
         url = f"{self.endpoint}{enc_path}" + (f"?{qs}" if qs else "")
         req = urllib.request.Request(
             url, data=data, method=method, headers=headers

@@ -59,6 +59,9 @@ class Config:
     hubble_tls_cert: str = ""
     hubble_tls_key: str = ""
     hubble_tls_client_ca: str = ""
+    # Local-client unix endpoint beside TCP (the reference serves
+    # unix:///var/run/cilium/hubble.sock, SURVEY §3.5). "" disables.
+    hubble_sock_path: str = ""
     # Static peer list for the peer service: [{"name", "address"}].
     hubble_peers: list = dataclasses.field(default_factory=list)
     node_name: str = ""
@@ -93,6 +96,9 @@ class Config:
     synthetic_pregen: int = 0
     capture_iface: str = ""  # live AF_PACKET interface ("" = default)
     external_socket: str = "/tmp/retina-events.sock"  # external feed
+    # Cilium agent monitor socket (gob payload stream) for the
+    # ciliumeventobserver plugin (reference config.go MonitorSockPath).
+    monitor_sock_path: str = "/var/run/cilium/monitor1_2.sock"
     # pktmon plugin (Windows): stream-server command + its socket. ""
     # command = the platform default (controller-pktmon.exe).
     pktmon_command: str = ""
@@ -115,9 +121,19 @@ class Config:
     # for debugging raw row flow.
     host_combine: bool = True
     # Depth of the in-flight transfer queue between the batcher thread and
-    # the device dispatch thread (engine.py). 0 = synchronous dispatch on
-    # the feed thread (no overlap).
-    feed_pipeline_depth: int = 2
+    # the device dispatch thread (engine.py), and the bound on concurrent
+    # fire-and-forget device submissions (transfers queued back-to-back on
+    # the device proxy so the host->device link never idles between
+    # dispatch round-trips). 0 = synchronous dispatch on the feed thread
+    # (no overlap).
+    feed_pipeline_depth: int = 3
+    # Max windows of batch_capacity coalesced into ONE host->device
+    # transfer when a flush quantum combines to more than one device
+    # batch: the wire crosses the link once and is sliced into
+    # batch_capacity-sized step inputs on device. Amortizes per-transfer
+    # round-trip latency (dominant on high-RTT links; one RTT per flush
+    # instead of one per device batch).
+    feed_coalesce_windows: int = 4
     # Smallest power-of-two host->device transfer shape: batches cross the
     # link at their own (bucketed) size and are padded to batch_capacity
     # on device, where HBM bandwidth makes padding free (engine pad jit).

@@ -41,8 +41,24 @@ class FilterManager:
             return
         with self._lock:
             ips = set(self._refs)
-        retry(lambda: self._apply(ips), attempts=self._retries,
-              base_delay_s=0.05)
+        # Retry covers TRANSIENT device-write failures only; overflow is
+        # handled inside the engine (clamp + lost_table_entries counter,
+        # engine.update_filter_ips) because backoff can't fix a
+        # deterministic condition. A final failure is logged, never
+        # raised into the pubsub callback that triggered the push — the
+        # reference likewise counts failures and stays up
+        # (manager_linux.go:62-100).
+        try:
+            retry(lambda: self._apply(ips), attempts=self._retries,
+                  base_delay_s=0.05)
+        except Exception:
+            from retina_tpu.metrics import get_metrics
+
+            get_metrics().filter_push_failures.inc()
+            self._log.exception(
+                "filter push failed after %d attempts (%d IPs)",
+                self._retries, len(ips),
+            )
 
     def _maybe_push(self) -> None:
         with self._lock:

@@ -80,6 +80,8 @@ class Metrics:
             mn.PLUGIN_RECONCILE_FAILURES, [mn.L_PLUGIN]
         )
         self.lost_events = c(mn.LOST_EVENTS, [mn.L_STAGE, mn.L_PLUGIN])
+        self.lost_table_entries = c(mn.LOST_TABLE_ENTRIES, [mn.L_TABLE])
+        self.filter_push_failures = c(mn.FILTER_PUSH_FAILURES, [])
         self.parsed_packets = c(mn.PARSED_PACKETS, [mn.L_PLUGIN])
         self.device_step_seconds = ex.new_histogram(
             mn.DEVICE_STEP_SECONDS,

@@ -68,6 +68,9 @@ class ShardedBatch:
     lost: int  # EVENTS dropped because a shard overflowed (sum of the
     # dropped rows' F.PACKETS weights — a combined row stands for many
     # events, parallel/combine.py)
+    events: int = 0  # EVENTS the kept rows stand for (same packet
+    # weighting as ``lost``) — what to count if this batch is dropped
+    # downstream instead of reaching the device
 
 
 def _next_bucket(n: int) -> int:
@@ -121,6 +124,7 @@ def partition_events(
         # ~22 ms per 131k-event batch, dominating the host feed loop).
         n = min(len(records), capacity)
         lost = int(records[n:, F.PACKETS].astype(np.uint64).sum())
+        kept = int(records[:n, F.PACKETS].astype(np.uint64).sum())
         b = bucket_for(n)
         if n == b:
             out = np.ascontiguousarray(records[:n], np.uint32)
@@ -128,21 +132,24 @@ def partition_events(
         else:
             out = np.zeros((1, b, NUM_FIELDS), np.uint32)
             out[0, :n] = records[:n]
-        return ShardedBatch(records=out,
-                            n_valid=np.array([n], np.uint32), lost=lost)
+        return ShardedBatch(records=out, n_valid=np.array([n], np.uint32),
+                            lost=lost, events=kept)
     n_valid = np.zeros((n_devices,), np.uint32)
     lost = 0
+    kept = 0
     if len(records):
         dev = canonical_conn_hash(records) % np.uint32(n_devices)
         counts = np.bincount(dev, minlength=n_devices)
         b = bucket_for(int(min(counts.max(), capacity)))
         out = np.zeros((n_devices, b, NUM_FIELDS), np.uint32)
+        total = int(records[:, F.PACKETS].astype(np.uint64).sum())
         for d in range(n_devices):
             rows = records[dev == d]
             n = min(len(rows), capacity)
             out[d, :n] = rows[:n]
             n_valid[d] = n
             lost += int(rows[n:, F.PACKETS].astype(np.uint64).sum())
+        kept = total - lost
     else:
         out = np.zeros((n_devices, bucket_for(0), NUM_FIELDS), np.uint32)
-    return ShardedBatch(records=out, n_valid=n_valid, lost=lost)
+    return ShardedBatch(records=out, n_valid=n_valid, lost=lost, events=kept)

@@ -140,8 +140,15 @@ class ShardedTelemetry:
             # Packet-weighted loss counts can exceed 2^32 in one batch;
             # the device totals are u32 and wrap (like every reference
             # kernel counter) — the host-side Prometheus lost_events
-            # counter (float64) stays exact.
-            jnp.asarray(int(lost) & 0xFFFFFFFF, jnp.uint32),
+            # counter (float64) stays exact. Device-resident scalars
+            # (the engine's coalesced-ingest outputs) pass through
+            # untouched — coercing them via int() would force a
+            # device->host readback per step.
+            jnp.asarray(
+                int(lost) & 0xFFFFFFFF
+                if isinstance(lost, (int, np.integer)) else lost,
+                jnp.uint32,
+            ),
         )
 
     # ------------------------------------------------------------------
@@ -186,7 +193,7 @@ class ShardedTelemetry:
     ) -> tuple[PipelineState, dict[str, jnp.ndarray]]:
         if self._end_window is None:
             self._end_window = self._build_end_window()
-        return self._end_window(state, jnp.float32(z_thresh))
+        return self._end_window(state, jnp.asarray(z_thresh, jnp.float32))
 
     # ------------------------------------------------------------------
     def _build_snapshot(self):

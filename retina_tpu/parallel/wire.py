@@ -14,9 +14,13 @@ Layout (indices into the packed minor axis):
 ==  =========  ========================================================
 ix  name       contents
 ==  =========  ========================================================
-0   TS_REL     nanoseconds since the batch base timestamp (u32; spreads
-               beyond ~4.29 s saturate — harmless: the device consumes
-               per-row time only for apiserver RTT matching)
+0   TS_REL     1 + nanoseconds since the batch base timestamp (u32;
+               spreads beyond ~4.29 s saturate — harmless: the device
+               consumes per-row time only for apiserver RTT matching).
+               0 means "no timestamp": a source that never stamps
+               round-trips to ts 0 exactly instead of inheriting the
+               batch base (which would feed phantom values into the
+               apiserver RTT latency matcher)
 1   SRC_IP     = schema F.SRC_IP
 2   DST_IP     = schema F.DST_IP
 3   PORTS      = schema F.PORTS
@@ -64,7 +68,11 @@ def pack_records(
     ].astype(np.uint64)
     nz = ts[ts > 0]
     base = np.uint64(nz.min()) if len(nz) else np.uint64(0)
-    rel = np.where(ts > base, np.minimum(ts - base, _U32), 0).astype(np.uint32)
+    rel = np.where(
+        ts > 0,
+        np.minimum(ts - base, _U32 - np.uint64(1)) + np.uint64(1),
+        0,
+    ).astype(np.uint32)
     out = np.empty(records.shape[:-1] + (PACKED_FIELDS,), np.uint32)
     out[..., 0] = rel
     out[..., 1] = records[..., F.SRC_IP]
@@ -97,12 +105,14 @@ def unpack_records_device(packed, base_lo, base_hi):
     surgery with the zero-extension to the step's static shape.
     """
     rel = packed[..., 0]
-    ts_lo = base_lo + rel
-    carry = (ts_lo < rel).astype(jnp.uint32)
+    relm1 = rel - jnp.uint32(1)  # wraps for rel==0; masked below
+    ts_lo = base_lo + relm1
+    carry = (ts_lo < relm1).astype(jnp.uint32)
+    stamped = rel > 0
     misc = packed[..., 7]
     cols = [None] * NUM_FIELDS
-    cols[F.TS_LO] = ts_lo
-    cols[F.TS_HI] = base_hi + carry
+    cols[F.TS_LO] = jnp.where(stamped, ts_lo, 0)
+    cols[F.TS_HI] = jnp.where(stamped, base_hi + carry, 0)
     cols[F.SRC_IP] = packed[..., 1]
     cols[F.DST_IP] = packed[..., 2]
     cols[F.PORTS] = packed[..., 3]
@@ -123,12 +133,14 @@ def unpack_records_device(packed, base_lo, base_hi):
 def unpack_records_numpy(packed: np.ndarray, base_lo, base_hi) -> np.ndarray:
     """Host mirror of unpack_records_device (tests)."""
     rel = packed[..., 0]
-    ts_lo = (np.uint32(base_lo) + rel).astype(np.uint32)
-    carry = (ts_lo < rel).astype(np.uint32)
+    relm1 = (rel - np.uint32(1)).astype(np.uint32)  # wraps for rel==0
+    ts_lo = (np.uint32(base_lo) + relm1).astype(np.uint32)
+    carry = (ts_lo < relm1).astype(np.uint32)
+    stamped = rel > 0
     misc = packed[..., 7]
     out = np.empty(packed.shape[:-1] + (NUM_FIELDS,), np.uint32)
-    out[..., F.TS_LO] = ts_lo
-    out[..., F.TS_HI] = np.uint32(base_hi) + carry
+    out[..., F.TS_LO] = np.where(stamped, ts_lo, 0)
+    out[..., F.TS_HI] = np.where(stamped, np.uint32(base_hi) + carry, 0)
     out[..., F.SRC_IP] = packed[..., 1]
     out[..., F.DST_IP] = packed[..., 2]
     out[..., F.PORTS] = packed[..., 3]

@@ -54,27 +54,21 @@ class PacketParserPlugin(Plugin):
 
     def compile(self) -> None:
         """Decode/prepare the source up front (the clang-compile analog:
-        pay parse cost before Start, never in the hot loop)."""
+        pay parse cost before Start, never in the hot loop).
+
+        Synthetic block pre-generation does NOT happen here: generating
+        a 2M-event ring takes ~20s on a small host, breaching the
+        pluginmanager's 10s reconcile SLA (the contract this repo itself
+        enforces — pluginmanager.go:25-28). The ring fills lazily inside
+        the Start feed loop instead.
+        """
         src = self.cfg.event_source
         if src == "synthetic":
             self._gen = TrafficGen(
                 n_flows=self.cfg.synthetic_flows, n_pods=self.cfg.n_pods
             )
             if self.cfg.synthetic_pregen > 0:
-                # Generate in large chunks (per-call cost of the Zipf
-                # sampler is O(n_flows)) and slice into emit-sized blocks.
-                total = self.cfg.synthetic_pregen * BLOCK
-                chunk = BLOCK * 16
                 self._pregen = []
-                for off in range(0, total, chunk):
-                    a = self._gen.batch(min(chunk, total - off))
-                    self._pregen += [
-                        a[i : i + BLOCK] for i in range(0, len(a), BLOCK)
-                    ]
-                self.log.info(
-                    "pre-generated %d blocks (%d events)",
-                    len(self._pregen), total,
-                )
         elif src == "pcap":
             from retina_tpu.sources.pcapdecode import decode_pcap_file
 
@@ -135,8 +129,28 @@ class PacketParserPlugin(Plugin):
         per_block_s = BLOCK / max(self.cfg.synthetic_rate, 1.0)
         next_t = time.monotonic()
         i = 0
+        # Lazy ring fill: generate in large chunks (per-call cost of the
+        # Zipf sampler is O(n_flows)) sliced into emit-sized blocks,
+        # interleaved with emitting — the ring completes within the
+        # first ~total/rate seconds of feed instead of stalling
+        # reconcile past its SLA.
+        ring_total = self.cfg.synthetic_pregen * BLOCK
+        chunk = BLOCK * 16
         while not stop.is_set():
             if self._pregen is not None:
+                if len(self._pregen) * BLOCK < ring_total:
+                    a = self._gen.batch(
+                        min(chunk, ring_total - len(self._pregen) * BLOCK)
+                    )
+                    new = [
+                        a[j : j + BLOCK] for j in range(0, len(a), BLOCK)
+                    ]
+                    self._pregen += new
+                    if len(self._pregen) * BLOCK >= ring_total:
+                        self.log.info(
+                            "pre-generated %d blocks (%d events)",
+                            len(self._pregen), ring_total,
+                        )
                 block = self._pregen[i % len(self._pregen)]
                 i += 1
             else:

@@ -70,3 +70,31 @@ def run_on_device(fn: Callable[..., T], *args: Any, **kwargs: Any) -> T:
     if len(box) == 2:
         raise box[0]
     return box[0]
+
+
+def submit_on_device(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
+    """Fire-and-forget: enqueue ``fn`` on the proxy thread and return
+    immediately.
+
+    The proxy queue is FIFO, so submissions execute in submission order,
+    interleaved with (and ordered against) ``run_on_device`` calls — a
+    later blocking call acts as a fence for everything submitted before
+    it. Exceptions are swallowed (nobody awaits the result): ``fn`` MUST
+    handle its own failures. Callers are responsible for bounding the
+    number of outstanding submissions (the engine uses a semaphore
+    released from inside the closure) or host memory pins the payloads
+    of an unbounded backlog.
+    """
+    if threading.current_thread() is _thread:
+        try:
+            fn(*args, **kwargs)
+        except BaseException:  # noqa: BLE001 — contract: fn self-handles
+            pass
+        return
+    q = _ensure_thread()
+    q.put((fn, args, kwargs, [], threading.Event()))
+
+
+def fence() -> None:
+    """Block until everything submitted before this call has executed."""
+    run_on_device(lambda: None)

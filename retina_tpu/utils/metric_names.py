@@ -61,6 +61,15 @@ ACTIVE_CONNECTIONS = PREFIX + "conntrack_active_connections"
 # Control-plane self metrics (reference pkg/metrics/metrics.go:14-120).
 PLUGIN_RECONCILE_FAILURES = PREFIX + "plugin_manager_failed_to_reconcile"
 LOST_EVENTS = PREFIX + "lost_events_counter"
+# Table entries (filter IPs / pod identities) dropped because a
+# fixed-capacity device table was full — the agent clamps and stays up
+# (reference counts per-IP map-write failures the same way,
+# manager_linux.go:62-100).
+LOST_TABLE_ENTRIES = PREFIX + "lost_table_entries_counter"
+# Filter-map device pushes that exhausted every retry (transient device
+# failure outlasting the backoff): the device filter set is stale until
+# the next successful push — invisible without this counter.
+FILTER_PUSH_FAILURES = PREFIX + "filter_push_failures_counter"
 PARSED_PACKETS = PREFIX + "parsed_packets_counter"
 DEVICE_STEP_SECONDS = PREFIX + "tpu_step_seconds"
 DEVICE_BATCH_FILL = PREFIX + "tpu_batch_fill_ratio"
@@ -83,6 +92,7 @@ L_QTYPE = "query_type"
 L_RCODE = "return_code"
 L_DIMENSION = "dimension"
 L_STAGE = "stage"
+L_TABLE = "table"
 L_PLUGIN = "plugin"
 L_STATE = "state"
 L_INTERFACE = "interface_name"

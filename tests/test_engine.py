@@ -227,13 +227,50 @@ def test_identity_overwrite_at_full_load():
 
     eng = SketchEngine(small_cfg(identity_slots=1 << 4))
     eng.update_identities({POD_NET + i: i for i in range(1, 9)})
-    with pytest.raises(ValueError):
-        eng.update_identities({POD_NET + i: i for i in range(1, 40)})
-    # Previous mapping untouched by the failed reconcile.
+    # Overfull reconcile: clamp-and-count, never crash (VERDICT r3 weak
+    # #4). The deterministic (sorted) subset keeps the lowest IPs, so
+    # the previously-tracked pods survive; the overflow is visible in
+    # lost_table_entries{table="identity"}.
+    from retina_tpu.metrics import get_metrics
+
+    eng.update_identities({POD_NET + i: i for i in range(1, 40)})
+    lost = get_metrics().lost_table_entries.labels(table="identity")
+    assert lost._value.get() == 39 - 8
     got = np.asarray(
-        eng.ident.lookup(jnp.asarray(np.array([POD_NET + 3], np.uint32)))
+        eng.ident.lookup(
+            jnp.asarray(np.array([POD_NET + 3, POD_NET + 30], np.uint32))
+        )
     )
-    assert got[0] == 3
+    assert got[0] == 3  # kept (inside the clamped subset)
+    assert got[1] == 0  # dropped (outside capacity)
+
+
+def test_filter_overflow_clamps_and_counts():
+    """2x-capacity IPs-of-interest push: the agent clamps to capacity,
+    counts the overflow in lost_table_entries{table="filter"}, and stays
+    up (manager_linux.go:62-100 counts per-IP failures the same way) —
+    no retry loop, no exception into the pubsub callback."""
+    from retina_tpu.managers.filtermanager import FilterManager
+    from retina_tpu.metrics import get_metrics
+
+    eng = SketchEngine(small_cfg(identity_slots=1 << 4))  # capacity 8
+    fm = FilterManager(apply_fn=eng.update_filter_ips)
+    fm.add_ips([int(POD_NET + i) for i in range(1, 17)], "test", "r1")
+    lost = get_metrics().lost_table_entries.labels(table="filter")
+    assert lost._value.get() == 16 - 8
+    # The lowest 8 IPs won the deterministic clamp and are active.
+    import jax.numpy as jnp
+
+    got = np.asarray(
+        eng.filter_map.lookup(
+            jnp.asarray(np.array([POD_NET + 1, POD_NET + 12], np.uint32))
+        )
+    )
+    assert got[0] == 1 and got[1] == 0
+    # The exposition carries the counter (scrape visibility).
+    from retina_tpu.exporter import get_exporter
+
+    assert b"lost_table_entries" in get_exporter().gather_text()
 
 
 def test_snapshot_never_stalls_feed():

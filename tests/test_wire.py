@@ -74,13 +74,22 @@ def test_saturation_of_narrow_lanes():
 
 
 def test_zero_timestamp_rows_keep_rel_zero():
+    """A source that never stamps must round-trip to ts 0 exactly —
+    NOT inherit the batch base timestamp, which would feed phantom
+    values into the apiserver RTT latency matcher."""
     rec = np.zeros((3, NUM_FIELDS), np.uint32)
     rec[0, F.TS_LO], rec[0, F.TS_HI] = 100, 1  # the only stamped row
     rec[1, F.SRC_IP] = 7  # unstamped real row
     packed, lo, hi = pack_records(rec)
     assert packed[1, 0] == 0 and packed[2, 0] == 0
     out = unpack_records_numpy(packed, lo, hi)
-    np.testing.assert_array_equal(out[0, :2], rec[0, :2])
+    np.testing.assert_array_equal(out, rec)  # exact, incl. unstamped
+    dev = np.asarray(
+        unpack_records_device(
+            jnp.asarray(packed), jnp.uint32(lo), jnp.uint32(hi)
+        )
+    )
+    np.testing.assert_array_equal(dev, rec)
 
 
 def test_spread_beyond_u32_saturates():
@@ -90,6 +99,8 @@ def test_spread_beyond_u32_saturates():
     packed, lo, hi = pack_records(rec)
     out = unpack_records_numpy(packed, lo, hi)
     np.testing.assert_array_equal(out[0], rec[0])
-    # saturated: clamped to base + (2^32 - 1), not wrapped past it
+    # saturated: clamped to base + (2^32 - 2), not wrapped past it (the
+    # +1 TS_REL bias that reserves 0 for "unstamped" costs one count of
+    # representable spread)
     got = (int(out[1, F.TS_HI]) << 32) | int(out[1, F.TS_LO])
-    assert got == ((0 << 32) | 1) + 0xFFFFFFFF
+    assert got == ((0 << 32) | 1) + 0xFFFFFFFE
