@@ -127,6 +127,14 @@ def translate_capture_to_jobs(
 ) -> list[CaptureJob]:
     """The TranslateCaptureToJobs entry point (:352)."""
     capture.validate()
+    if capture.spec.output.is_empty():
+        # Admission is lenient (the operator's managed-storage reconcile
+        # may fill the output in); by job-creation time SOME output must
+        # exist or the capture artifacts would have nowhere to go.
+        raise ValidationError(
+            "capture needs at least one output location "
+            "(or managed storage enabled)"
+        )
     t = capture.spec.target
     if t.pod_selector or t.namespace_selector:
         target_pods = select_pods(capture, pods, namespace_labels)

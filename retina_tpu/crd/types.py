@@ -186,10 +186,18 @@ class CaptureOutput:
     blob_upload_secret: str = ""
     s3_upload: dict[str, str] = dataclasses.field(default_factory=dict)
 
+    def is_empty(self) -> bool:
+        """No output location configured (the managed-storage gate and
+        the translator's job-time guard share this predicate)."""
+        return not (self.host_path or self.persistent_volume_claim
+                    or self.blob_upload_secret or self.s3_upload)
+
     def validate(self) -> None:
-        if not (self.host_path or self.persistent_volume_claim
-                or self.blob_upload_secret or self.s3_upload):
-            raise ValidationError("capture needs at least one output location")
+        # An EMPTY output is admissible: the reference CRD does not
+        # require one, because the operator's managed-storage path fills
+        # BlobUpload in during reconcile (controller.go:310-350 /
+        # capture/managed.py). Translation enforces that SOME output
+        # exists by job-creation time (translator.py).
         if self.s3_upload:
             for req in ("bucket", "region"):
                 if req not in self.s3_upload:

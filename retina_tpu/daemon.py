@@ -121,6 +121,7 @@ class Daemon:
                 tls_cert=cfg.hubble_tls_cert,
                 tls_key=cfg.hubble_tls_key,
                 tls_client_ca=cfg.hubble_tls_client_ca,
+                unix_socket=cfg.hubble_sock_path,
             )
             self.hubble_metrics_server = None
             if cfg.hubble_metrics_addr:

@@ -265,7 +265,8 @@ class SketchEngine:
             n_valid=np.zeros((self.n_devices,), np.uint32),
             lost=0,
         )
-        self._dispatch_sharded(full, now_s=1, n_raw=0)
+        self._dispatch_sharded(full, now_s=1, n_raw=0,
+                               record_metrics=False)
 
         def warm():
             self.state, win = self.sharded.end_window(
@@ -289,7 +290,8 @@ class SketchEngine:
         # multi-window keys are big enough for a cold compile to stall
         # the proxy thread mid-feed.
         self._dispatch(
-            np.zeros((0, NUM_FIELDS), np.uint32), now_s=1
+            np.zeros((0, NUM_FIELDS), np.uint32), now_s=1,
+            record_metrics=False,
         )
         if self.cfg.feed_coalesce_windows > 1:
             from retina_tpu.parallel.partition import _next_bucket
@@ -315,12 +317,16 @@ class SketchEngine:
         """Feed one host block synchronously (tests / direct callers)."""
         self._dispatch(records, now_s or int(time.time()))
 
-    def _dispatch(self, records: np.ndarray, now_s: int) -> None:
+    def _dispatch(
+        self, records: np.ndarray, now_s: int,
+        record_metrics: bool = True,
+    ) -> None:
         sb = partition_events(
             records, self.n_devices, self.cfg.batch_capacity,
             min_bucket=self.cfg.transfer_min_bucket,
         )
-        self._dispatch_sharded(sb, now_s, n_raw=len(records))
+        self._dispatch_sharded(sb, now_s, n_raw=len(records),
+                               record_metrics=record_metrics)
 
     def _ingest_fn(self, bucket: int, packed: bool):
         """Per-bucket jit that turns ONE transferred (D, bucket, P) wire
@@ -398,7 +404,7 @@ class SketchEngine:
 
     def _dispatch_sharded(
         self, sb: "ShardedBatch", now_s: int, n_raw: int,
-        sync: bool = True,
+        sync: bool = True, record_metrics: bool = True,
     ) -> None:
         """Pack + device_put + step dispatch for an already-partitioned
         batch.
@@ -415,7 +421,7 @@ class SketchEngine:
             ident = self.ident
             fmap = self.filter_map
         m = get_metrics()
-        if sb.lost:
+        if sb.lost and record_metrics:
             m.lost_events.labels(stage="partition", plugin="engine").inc(sb.lost)
         if self.cfg.transfer_packed:
             from retina_tpu.parallel.wire import pack_records
@@ -429,7 +435,8 @@ class SketchEngine:
             wire = sb.records if sync else np.array(sb.records)
             b_lo = b_hi = np.uint32(0)
             packed = False
-        m.transfer_bytes.inc(wire.nbytes)
+        if record_metrics:
+            m.transfer_bytes.inc(wire.nbytes)
         bucket = wire.shape[1]
         meta = np.empty((4 + self.n_devices,), np.uint32)
         meta[0], meta[1] = b_lo, b_hi
@@ -459,21 +466,29 @@ class SketchEngine:
                         lost=lost_dev if w == 0 else self._zero_u32,
                     )
                 self.state = st
-            m.transfer_seconds.observe(t0 - t_x0)
-            m.device_step_seconds.observe(time.perf_counter() - t0)
-            # Fill of the step capacity actually dispatched (windows x
-            # batch_capacity): identical to the historical series for
-            # single-window batches, and stays a 0..1 ratio for
-            # coalesced multi-window transfers.
-            m.device_batch_fill.set(
-                n_valid_total
-                / max(
-                    self.n_devices * self.cfg.batch_capacity * len(wins),
-                    1,
+            if record_metrics:
+                # Warm-up dispatches (compile()) skip observation: a
+                # one-shot 30-100s cold-compile sample would inflate
+                # the histogram p99/max forever and seed transfer_bytes
+                # with a synthetic zero batch.
+                m.transfer_seconds.observe(t0 - t_x0)
+                m.device_step_seconds.observe(time.perf_counter() - t0)
+                # Fill of the step capacity actually dispatched
+                # (windows x batch_capacity): identical to the
+                # historical series for single-window batches, and
+                # stays a 0..1 ratio for coalesced multi-window
+                # transfers.
+                m.device_batch_fill.set(
+                    n_valid_total
+                    / max(
+                        self.n_devices
+                        * self.cfg.batch_capacity
+                        * len(wins),
+                        1,
+                    )
                 )
-            )
-            self._steps += len(wins)
-            self._events_in += n_raw
+                self._steps += len(wins)
+                self._events_in += n_raw
 
         if sync:
             run_on_device(xfer_and_step)
@@ -640,10 +655,13 @@ class SketchEngine:
             self.log.error("dispatch worker dead; dropping %s", item[0])
             if item[0] == "step":
                 # Packet-weighted, like every other loss site: a
-                # combined row stands for many events.
+                # combined row stands for many events. Include the
+                # batch's partition-overflow losses too — they are
+                # normally counted inside _dispatch_sharded, which will
+                # never run for a dropped item.
                 get_metrics().lost_events.labels(
                     stage="dispatch", plugin="engine"
-                ).inc(int(item[1].events))
+                ).inc(int(item[1].events) + int(item[1].lost))
 
         def submit(item):
             if q is not None:
@@ -739,8 +757,12 @@ class SketchEngine:
                 worker.join(timeout=30.0)
             # Drain fire-and-forget submissions (FIFO fence) so the
             # state a follow-up checkpoint saves includes every batch
-            # submitted before shutdown.
-            fence()
+            # submitted before shutdown. Bounded like the queue/join
+            # above: a wedged proxy must not hang shutdown forever.
+            if not fence(timeout=60.0):
+                self.log.error(
+                    "device proxy did not drain within 60s at shutdown"
+                )
 
     # -- scrape-time readout -----------------------------------------
     def snapshot(self, max_age_s: float = 0.5) -> dict[str, Any]:

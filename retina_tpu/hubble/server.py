@@ -54,10 +54,12 @@ class HubbleServer:
         tls_cert: str = "",
         tls_key: str = "",
         tls_client_ca: str = "",
+        unix_socket: str = "",
     ):
         self._log = logger("hubble")
         self.observer = observer
         self.addr = addr
+        self.unix_socket = unix_socket
         # ``peers`` may be a static list or a zero-arg callable returning
         # the CURRENT peer set (daemon wires the node store in, so peer
         # listings track cluster membership instead of boot-time config).
@@ -92,6 +94,19 @@ class HubbleServer:
         else:
             self.port = self._server.add_insecure_port(addr)
             self.tls = False
+        if unix_socket:
+            # Local-client endpoint beside TCP, like Hubble's
+            # unix:///var/run/cilium/hubble.sock (SURVEY §3.5; the
+            # reference daemon serves both). Always insecure: the socket
+            # is permission-guarded by the filesystem, and local CLIs
+            # (hubble observe) dial it without TLS.
+            import os
+
+            try:
+                os.unlink(unix_socket)
+            except OSError:
+                pass
+            self._server.add_insecure_port(f"unix:{unix_socket}")
 
     def _init_self_metrics(self) -> None:
         """hubble_* families in the DEDICATED hubble registry (served by

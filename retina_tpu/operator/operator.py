@@ -52,6 +52,8 @@ class Operator:
         leading: Optional[Any] = None,
         job_runner: Optional[Any] = None,
         cluster_nodes: Optional[Any] = None,
+        storage_manager: Optional[Any] = None,
+        secret_writer: Optional[Any] = None,
     ):
         """``status_sink(kind, obj)`` is called when an object's status
         settles — the kube backend passes KubeBridge.patch_status so
@@ -80,6 +82,15 @@ class Operator:
         # Live cluster node inventory for capture translation (the kube
         # backend wires a node watcher); falls back to the static list.
         self.cluster_nodes = cluster_nodes
+        # Managed capture storage (capture/managed.py; reference
+        # controller.go:310-350): when a Capture names no output and a
+        # manager is configured, the operator mints a write-only
+        # container SAS. ``secret_writer(namespace, name, sas_url) ->
+        # secret name`` stores it as a k8s Secret (kube mode); without
+        # one the SAS rides in the spec directly (in-process mode, where
+        # BlobOutput accepts a literal URL).
+        self.storage_manager = storage_manager
+        self.secret_writer = secret_writer
         # Bounded not-yet-synced deferrals per capture key.
         self._defers: dict[str, int] = {}
         self.max_defers = 24  # x5s = 2 min of inventory warm-up
@@ -204,6 +215,32 @@ class Operator:
             t.daemon = True
             t.start()
             return True
+
+        # Managed storage: a Capture with NO output location gets a
+        # provisioned container + write-only SAS before translation
+        # (reference controller.go:310-350 creates the secret, sets
+        # Spec.OutputConfiguration.BlobUpload, then creates jobs).
+        out = cap.spec.output
+        if self.storage_manager is not None and out.is_empty():
+            try:
+                sas = self.storage_manager.create_container_sas_url(
+                    cap.namespace, cap.spec.duration_s
+                )
+                if self.secret_writer is not None:
+                    out.blob_upload_secret = self.secret_writer(
+                        cap.namespace, f"capture-blob-{cap.name}", sas
+                    )
+                else:
+                    out.blob_upload_secret = sas
+                self._sync_status(KIND_CAPTURE, cap)
+            except Exception as e:  # provisioning failed: Fail loudly
+                cap.status.phase = "Failed"
+                cap.status.message = f"managed storage: {e}"
+                self._log.warning(
+                    "capture %s managed storage failed: %s", cap.name, e
+                )
+                self._sync_status(KIND_CAPTURE, cap)
+                return
 
         try:
             pods = (

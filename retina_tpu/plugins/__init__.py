@@ -17,6 +17,7 @@ from retina_tpu.plugins.api import (
 
 # Self-registration imports (each module calls registry.add at import).
 from retina_tpu.plugins import (  # noqa: F401
+    ciliumeventobserver,
     conntrack_gc,
     dns,
     dropreason,

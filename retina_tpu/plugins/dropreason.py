@@ -25,7 +25,11 @@ from retina_tpu.plugins.api import Plugin
 from retina_tpu.sources import procfs
 
 # Reason ids 1..7 used by synthetic/pcap sources map to the reference's
-# drop reasons (dropreason kprobe sites); host-derived reasons use names.
+# drop reasons (dropreason kprobe sites); host-derived reasons use
+# names. 8..13 carry Cilium dataplane reasons mapped by the
+# ciliumeventobserver ingest (sources/cilium_monitor.py) — the reason
+# axis is a bounded rectangle (n_drop_reasons=16), so Cilium's sparse
+# 130+ id space folds into named buckets instead of clamping to 15.
 DROP_REASONS = {
     0: "unknown",
     1: "iptable_rule_drop",
@@ -35,6 +39,12 @@ DROP_REASONS = {
     5: "conntrack_add_drop",
     6: "softnet_drop",
     7: "listen_overflow",
+    8: "policy_denied",
+    9: "invalid_packet",
+    10: "invalid_source_ip",
+    11: "conntrack_invalid",
+    12: "unsupported_proto",
+    13: "cilium_other",
 }
 
 

@@ -67,12 +67,21 @@ class Server:
         self._render_stop = threading.Event()
         self._render_thread: threading.Thread | None = None
         self._render_flight = threading.Lock()
+        self._render_failing = False
+        # First moment a STALE body was served with refresh demand
+        # outstanding; None once a render lands. Staleness-under-demand
+        # is the failure signal — it catches a renderer that HANGS as
+        # well as one that raises (an idle gap with no scrapes never
+        # starts the clock).
+        self._stale_since: float | None = None
 
     def _render(self) -> bytes:
         body = self._gather()
         with self._cache_lock:
             self._cache_body = body
             self._cache_time = time.monotonic()
+            self._render_failing = False
+            self._stale_since = None
         return body
 
     def _render_loop(self) -> None:
@@ -84,7 +93,13 @@ class Server:
             try:
                 self._render()
             except Exception:
+                self._render_failing = True
                 _log.exception("background metrics render failed")
+
+    # Serve-stale grace: with the renderer persistently failing, a body
+    # older than this many TTLs stops being served — a frozen-but-200
+    # exposition would hide the failure from every alert.
+    STALE_FAIL_TTLS = 10
 
     def _metrics_body(self) -> bytes:
         if self._cache_ttl <= 0:
@@ -95,7 +110,22 @@ class Server:
         if body and age < self._cache_ttl:
             return body
         if body and self._render_thread is not None:
-            # Serve stale, refresh off the scrape path.
+            # Serve stale, refresh off the scrape path — but not
+            # forever: a renderer that keeps failing OR hanging must
+            # surface as a failed scrape, not as indefinitely frozen
+            # values. The clock starts at the first stale-served scrape
+            # and resets when a render completes.
+            now = time.monotonic()
+            with self._cache_lock:
+                if self._stale_since is None:
+                    self._stale_since = now
+                stalled = now - self._stale_since
+            if stalled > max(self.STALE_FAIL_TTLS * self._cache_ttl, 10.0):
+                raise RuntimeError(
+                    f"metrics render stalled {stalled:.0f}s "
+                    f"(failing={self._render_failing}); cache "
+                    f"{age:.0f}s old"
+                )
             self._render_kick.set()
             return body
         # First render (start() pre-warms, so this is tests/direct

@@ -95,6 +95,16 @@ def submit_on_device(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
     q.put((fn, args, kwargs, [], threading.Event()))
 
 
-def fence() -> None:
-    """Block until everything submitted before this call has executed."""
-    run_on_device(lambda: None)
+def fence(timeout: float | None = None) -> bool:
+    """Block until everything submitted before this call has executed.
+
+    Returns False if ``timeout`` (seconds) elapsed first — a wedged
+    proxy thread (the failure mode this module contains) must not turn
+    a bounded shutdown into an unbounded hang.
+    """
+    if threading.current_thread() is _thread:
+        return True
+    q = _ensure_thread()
+    done = threading.Event()
+    q.put((lambda: None, (), {}, [], done))
+    return done.wait(timeout)

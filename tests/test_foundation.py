@@ -213,6 +213,44 @@ def test_metrics_render_cache():
         srv.stop()
 
 
+def test_metrics_render_failure_surfaces_after_grace():
+    """A persistently failing renderer must eventually FAIL the scrape
+    (alertable) instead of serving a frozen cached body forever."""
+    state = {"fail": False}
+
+    def gather() -> bytes:
+        if state["fail"]:
+            raise RuntimeError("gauge callback broke")
+        return b"ok_metric 1.0\n"
+
+    srv = Server("127.0.0.1:0", gather=gather, metrics_cache_ttl_s=0.05)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        assert b"ok_metric" in urllib.request.urlopen(
+            f"{base}/metrics").read()
+        state["fail"] = True
+        # Within the grace window: stale body still served (kick +
+        # background failure marks _render_failing).
+        urllib.request.urlopen(f"{base}/metrics").read()
+        deadline = time.monotonic() + 5
+        while not srv._render_failing and time.monotonic() < deadline:
+            urllib.request.urlopen(f"{base}/metrics").read()
+            time.sleep(0.02)
+        assert srv._render_failing
+        # Past the grace window (10xTTL floor-capped at 10s): simulate
+        # prolonged staleness-under-demand by back-dating the
+        # stale-since clock; the scrape must then 500 (this fires for a
+        # HANGING renderer too — the clock, not the exception, is the
+        # signal).
+        srv._stale_since = (srv._stale_since or time.monotonic()) - 60.0
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/metrics")
+        assert ei.value.code == 500
+    finally:
+        srv.stop()
+
+
 # --------------------------------------------------------------- common
 def test_retina_endpoint_and_dirtycache():
     ep = RetinaEndpoint(

@@ -1,0 +1,280 @@
+"""Go encoding/gob codec subset + Cilium monitor-socket ingest.
+
+The decoder must interoperate with a REAL ``gob.Encoder`` stream (the
+Cilium monitor socket), so the first test pins the worked example from
+the gob documentation byte-for-byte — if our byte-level understanding of
+the format drifted, that test (not just a self-roundtrip) fails.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from retina_tpu.events.schema import (
+    EV_DROP,
+    EV_FORWARD,
+    F,
+    VERDICT_DROPPED,
+    ip_to_u32,
+)
+from retina_tpu.sources.cilium_monitor import (
+    MSG_DROP,
+    MSG_POLICY_VERDICT,
+    MSG_TRACE,
+    PAYLOAD_EVENT_SAMPLE,
+    events_to_records,
+    parse_perf_sample,
+)
+from retina_tpu.sources.gobcodec import (
+    T_BYTES,
+    T_INT,
+    T_UINT,
+    GobStreamDecoder,
+    GobStructEncoder,
+)
+
+# The gob documentation's worked example: type Point struct { X, Y int }
+# with value Point{22, 33} encodes to exactly these two messages.
+_GOB_DOC_POINT = bytes.fromhex(
+    "1fff810301010550"  # len 31, def type 65, StructT, CommonType{
+    "6f696e7401ff8200"  # "Point", Id 65 }
+    "0102010158010400"  # Field [ {X, int}
+    "0101590104000000"  #         {Y, int} ] end end
+    "07ff82012c014200"  # len 7, type 65, X=22, Y=33
+)
+
+
+def _payload_encoder() -> GobStructEncoder:
+    """payload.Payload{Data []byte, CPU int, Lost uint64, Type int}."""
+    return GobStructEncoder(
+        "Payload",
+        [("Data", T_BYTES), ("CPU", T_INT), ("Lost", T_UINT),
+         ("Type", T_INT)],
+    )
+
+
+def _udp_frame(src="10.1.0.4", dst="10.1.0.9", sport=3333, dport=53,
+               payload=b"x" * 8) -> bytes:
+    """Minimal Ethernet+IPv4+UDP frame."""
+    ip_len = 20 + 8 + len(payload)
+    ip = struct.pack(
+        ">BBHHHBBH4s4s", 0x45, 0, ip_len, 0, 0, 64, 17, 0,
+        socket.inet_aton(src), socket.inet_aton(dst),
+    )
+    udp = struct.pack(">HHHH", sport, dport, 8 + len(payload), 0)
+    return b"\x00" * 12 + b"\x08\x00" + ip + udp + payload
+
+
+def _drop_data(frame: bytes, reason: int = 130, ifindex: int = 7) -> bytes:
+    """DropNotify header (36 bytes) + captured frame."""
+    hdr = bytearray(36)
+    hdr[0] = MSG_DROP
+    hdr[1] = reason
+    struct.pack_into("<I", hdr, 32, ifindex)
+    return bytes(hdr) + frame
+
+
+def _trace_data(frame: bytes, obs: int = 10, version: int = 0) -> bytes:
+    hdr = bytearray(48 if version else 32)
+    hdr[0] = MSG_TRACE
+    hdr[1] = obs
+    struct.pack_into("<H", hdr, 14, version)
+    struct.pack_into("<I", hdr, 28, 3)
+    return bytes(hdr) + frame
+
+
+# ------------------------------------------------------------------ gob
+def test_gob_doc_example_decodes():
+    vals = GobStreamDecoder().feed(_GOB_DOC_POINT)
+    assert vals == [{"X": 22, "Y": 33}]
+
+
+def test_gob_doc_example_encodes():
+    enc = GobStructEncoder("Point", [("X", T_INT), ("Y", T_INT)])
+    assert enc.encode({"X": 22, "Y": 33}) == _GOB_DOC_POINT
+
+
+def test_payload_roundtrip_with_zero_omission():
+    enc = _payload_encoder()
+    dec = GobStreamDecoder()
+    msgs = [
+        {"Data": b"\x01\x02\x03", "CPU": 2, "Lost": 0, "Type": 9},
+        {"Data": b"", "CPU": 0, "Lost": 12, "Type": 2},  # RecordLost
+        {"Data": b"\xff" * 300, "CPU": -1, "Type": 9},  # multi-byte len
+    ]
+    wire = b"".join(enc.encode(m) for m in msgs)
+    got = dec.feed(wire)
+    assert got[0] == {"Data": b"\x01\x02\x03", "CPU": 2, "Type": 9}
+    assert got[1] == {"Lost": 12, "Type": 2}  # zero fields omitted
+    assert got[2]["Data"] == b"\xff" * 300 and got[2]["CPU"] == -1
+
+
+def test_gob_incremental_feed_byte_at_a_time():
+    enc = _payload_encoder()
+    wire = enc.encode({"Data": b"abc", "Type": 9})
+    dec = GobStreamDecoder()
+    out = []
+    for i in range(len(wire)):
+        out += dec.feed(wire[i : i + 1])
+    assert out == [{"Data": b"abc", "Type": 9}]
+
+
+def test_gob_corrupt_length_prefix_raises_not_stalls():
+    """A desynced stream must RAISE (caller reconnects), not be treated
+    as forever-incomplete while the buffer grows unboundedly."""
+    dec = GobStreamDecoder()
+    with pytest.raises(Exception):
+        dec.feed(b"\xf0junk")  # count byte says 16 length bytes
+    dec2 = GobStreamDecoder()
+    # Validly-encoded but absurd message length (> 1GB Go cap).
+    with pytest.raises(Exception):
+        dec2.feed(bytes([0xFC]) + (2 << 30).to_bytes(4, "big"))
+
+
+def test_gob_rejects_oversized_counts():
+    # A hostile slice count must not allocate unbounded memory.
+    dec = GobStreamDecoder()
+    dec.feed(_GOB_DOC_POINT)  # register type 65
+    bad = bytes([6, 0xFF, 0x82, 0x01, 0xF8]) + b"\xff" * 2
+    with pytest.raises(Exception):
+        for _ in dec.feed(bad):
+            pass
+
+
+# -------------------------------------------------------- perf parsing
+def test_drop_notify_parses_to_drop_record():
+    from retina_tpu.sources.cilium_monitor import REASON_INVALID_PACKET
+
+    # Cilium reason 130 (invalid source mac) folds into the bounded
+    # repo reason axis as invalid_packet.
+    ev = parse_perf_sample(_drop_data(_udp_frame(), reason=130, ifindex=7))
+    assert ev is not None
+    assert ev.event_type == EV_DROP
+    assert ev.drop_reason == REASON_INVALID_PACKET
+    assert ev.ifindex == 7
+    rec, _ = events_to_records([ev], now_ns=10**9)
+    assert len(rec) == 1
+    assert rec[0, F.EVENT_TYPE] == EV_DROP
+    assert rec[0, F.VERDICT] == VERDICT_DROPPED
+    assert rec[0, F.DROP_REASON] == REASON_INVALID_PACKET
+    assert rec[0, F.SRC_IP] == ip_to_u32("10.1.0.4")
+    assert rec[0, F.DST_IP] == ip_to_u32("10.1.0.9")
+    assert rec[0, F.IFINDEX] == 7
+
+
+def test_trace_notify_v0_and_v1_header_lengths():
+    for version in (0, 1):
+        ev = parse_perf_sample(_trace_data(_udp_frame(), version=version))
+        assert ev is not None
+        rec, _ = events_to_records([ev])
+        assert len(rec) == 1, f"version {version} frame misaligned"
+        assert rec[0, F.EVENT_TYPE] == EV_FORWARD
+
+
+def test_policy_verdict_negative_is_drop():
+    from retina_tpu.sources.cilium_monitor import REASON_POLICY_DENIED
+
+    hdr = bytearray(32)
+    hdr[0] = MSG_POLICY_VERDICT
+    struct.pack_into("<i", hdr, 20, -133)  # policy denied
+    ev = parse_perf_sample(bytes(hdr) + _udp_frame())
+    assert ev is not None
+    assert ev.event_type == EV_DROP
+    assert ev.drop_reason == REASON_POLICY_DENIED
+
+
+def test_non_packet_messages_skipped():
+    assert parse_perf_sample(bytes([2]) + b"\x00" * 64) is None  # debug
+    assert parse_perf_sample(b"") is None
+
+
+def test_event_index_survives_undecodable_frames():
+    """Frame 1 is garbage (dropped by the packet decoder); frame 2's
+    metadata must still land on frame 2's record — the index ride-along
+    through the pcap timestamp is what guarantees alignment."""
+    evs = [
+        parse_perf_sample(_drop_data(_udp_frame(src="10.1.0.1"), 1)),
+        parse_perf_sample(_drop_data(b"\xde\xad\xbe\xef", 2)),
+        parse_perf_sample(_drop_data(_udp_frame(src="10.1.0.3"), 3)),
+    ]
+    rec, _ = events_to_records([e for e in evs if e is not None])
+    assert len(rec) == 2
+    assert rec[0, F.SRC_IP] == ip_to_u32("10.1.0.1")
+    assert rec[0, F.DROP_REASON] == 1
+    assert rec[1, F.SRC_IP] == ip_to_u32("10.1.0.3")
+    assert rec[1, F.DROP_REASON] == 3
+
+
+# ----------------------------------------------------- plugin end-to-end
+def test_plugin_ingests_from_monitor_socket(tmp_path):
+    """A fake Cilium agent serves gob payloads over a unix socket; the
+    plugin must decode them into records that reach the sink (the
+    VERDICT r3 'done' criterion for monitor-socket wire compat)."""
+    from retina_tpu.config import Config
+    from retina_tpu.plugins.api import QueueSink
+    from retina_tpu.plugins.ciliumeventobserver import (
+        CiliumEventObserverPlugin,
+    )
+
+    sock_path = str(tmp_path / "monitor1_2.sock")
+    server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    server.bind(sock_path)
+    server.listen(1)
+
+    def serve():
+        conn, _ = server.accept()
+        enc = _payload_encoder()
+        payloads = [
+            {"Data": _drop_data(_udp_frame(src="10.9.0.1"), 133),
+             "Type": PAYLOAD_EVENT_SAMPLE},
+            {"Data": _trace_data(_udp_frame(src="10.9.0.2")),
+             "Type": PAYLOAD_EVENT_SAMPLE},
+            {"Lost": 5, "Type": 2},  # RecordLost
+        ]
+        wire = b"".join(enc.encode(p) for p in payloads)
+        # Dribble to exercise incremental gob framing over the socket.
+        for i in range(0, len(wire), 7):
+            conn.sendall(wire[i : i + 7])
+            time.sleep(0.001)
+        time.sleep(0.5)
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+
+    cfg = Config()
+    cfg.monitor_sock_path = sock_path
+    plugin = CiliumEventObserverPlugin(cfg)
+    sink = QueueSink(max_blocks=64)
+    plugin.set_sink(sink)
+    plugin.generate()
+    stop = threading.Event()
+    pt = threading.Thread(
+        target=plugin.start, args=(stop,), daemon=True
+    )
+    pt.start()
+
+    got: list[np.ndarray] = []
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and sum(len(r) for r in got) < 2:
+        got += [r for r, _plugin in sink.drain(max_blocks=16)]
+        time.sleep(0.02)
+    stop.set()
+    pt.join(timeout=5)
+    server.close()
+
+    rec = np.concatenate(got) if got else np.zeros((0, 16), np.uint32)
+    assert len(rec) == 2
+    srcs = set(int(x) for x in rec[:, F.SRC_IP])
+    assert srcs == {ip_to_u32("10.9.0.1"), ip_to_u32("10.9.0.2")}
+    from retina_tpu.sources.cilium_monitor import REASON_POLICY_DENIED
+
+    drop = rec[rec[:, F.EVENT_TYPE] == EV_DROP]
+    assert len(drop) == 1
+    assert drop[0, F.DROP_REASON] == REASON_POLICY_DENIED

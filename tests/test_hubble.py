@@ -189,6 +189,27 @@ def test_hubble_grpc_end_to_end():
         srv.stop()
 
 
+def test_hubble_unix_socket_observe(tmp_path):
+    """The server additionally listens on a unix socket for local
+    clients (the reference serves unix:///var/run/cilium/hubble.sock,
+    SURVEY §3.5); the observe path must work end-to-end over it."""
+    sock = str(tmp_path / "hubble.sock")
+    obs = FlowObserver(capacity=64, cache=cache_with_pods())
+    srv = HubbleServer(obs, addr="127.0.0.1:0", unix_socket=sock)
+    srv.start()
+    try:
+        client = HubbleClient(f"unix:{sock}")
+        obs.consume(np.stack([mk_record(dport=80)]))
+        flows = list(client.get_flows(last=10, timeout=5))
+        assert len(flows) == 1
+        assert flows[0]["l4"]["destination_port"] == 80
+        status = client.server_status()
+        assert status["seen_flows"] == 1
+        client.close()
+    finally:
+        srv.stop()
+
+
 def test_observer_lazy_decode_memoizes():
     """The writer stores raw rows (hot path ~9M flows/s); the FIRST read
     decodes and memoizes into the ring, so N readers decode once."""
