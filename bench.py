@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import traceback
@@ -467,8 +468,15 @@ def main() -> None:
             "error": f"{type(e).__name__}: {e}".splitlines()[0][:400],
         }
     print(json.dumps(out), flush=True)
-    if "error" in out:
-        sys.exit(1)
+    # Skip interpreter teardown on BOTH paths: daemon threads (device
+    # proxy, watchers) may sit inside runtime calls, and tearing the
+    # accelerator client down under them has aborted the process AFTER
+    # the result line (pthread-cancel + C++ unwind -> std::terminate on
+    # the tunnel backend). The JSON above is flushed; exit codes must
+    # reflect the bench, not teardown ordering.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(1 if "error" in out else 0)
 
 
 if __name__ == "__main__":

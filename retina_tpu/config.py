@@ -114,7 +114,15 @@ class Config:
     compilation_cache_dir: str = ""
     batch_capacity: int = 1 << 15  # events per device batch
     window_seconds: float = 1.0  # entropy/anomaly window
-    flush_interval_s: float = 0.05  # max host-side batching latency
+    # Host-side batching latency when the dispatch pipeline is IDLE: a
+    # lightly-loaded agent flushes small batches at this cadence for
+    # low metric latency.
+    flush_interval_s: float = 0.05
+    # Under load (dispatches in flight) the feed keeps accumulating past
+    # flush_interval_s — bigger quanta raise the combine ratio and
+    # amortize per-flush fixed costs — but never beyond this age. Must
+    # stay below the metrics publish interval (1s) or scrapes lag.
+    flush_max_age_s: float = 0.4
     mesh_devices: int = 0  # 0 = all local devices
     # Host-side RLE combining before the host->device transfer (the eBPF
     # map pre-aggregation analog, parallel/combine.py). Lossless; off only
@@ -141,6 +149,17 @@ class Config:
     # 12-lane packed wire format (parallel/wire.py) instead of the 16-lane
     # schema layout; unpacked on device. Off only for debugging.
     transfer_packed: bool = True
+    # v2 wire: device-resident flow-descriptor dictionary. Each distinct
+    # combined-flow descriptor crosses the link ONCE (12 lanes + id);
+    # every later occurrence crosses as a 16-byte (id, packets, bytes,
+    # ts_rel) tuple and the descriptor lanes are gathered back from HBM
+    # (parallel/flowdict.py + engine ingest). Steady-state wire
+    # bytes/event drop ~3x on long-lived flows. Requires transfer_packed.
+    wire_flow_dict: bool = True
+    # Device descriptor-table slots (48 B/slot/device). Must exceed the
+    # live distinct-descriptor count or the dictionary cycles
+    # (generation clear -> one re-upload burst).
+    flow_dict_slots: int = 1 << 18
     # Under sustained load, accumulate up to this many events per
     # combine+flush quantum (bigger quanta raise the combine ratio — more
     # duplicate descriptors per pass — at bounded added latency). The

@@ -31,13 +31,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from retina_tpu.config import Config
-from retina_tpu.events.schema import NUM_FIELDS
+from retina_tpu.events.schema import F, NUM_FIELDS
 from retina_tpu.log import logger
 from retina_tpu.metrics import get_metrics
 from retina_tpu.models.identity import HostIdentityTable, IdentityMap
 from retina_tpu.models.pipeline import PipelineConfig, TelemetryPipeline
 from retina_tpu.parallel.combine import combine_records
-from retina_tpu.parallel.partition import ShardedBatch, partition_events
+from retina_tpu.parallel.flowdict import make_flow_dict
+from retina_tpu.parallel.partition import (
+    ShardedBatch, _next_bucket, partition_events,
+)
 from retina_tpu.parallel.telemetry import ShardedTelemetry, topk_from_snapshot
 from retina_tpu.plugins.api import QueueSink
 from retina_tpu.utils.device_proxy import (
@@ -121,6 +124,31 @@ class SketchEngine:
         self._inflight = threading.Semaphore(
             max(1, cfg.feed_pipeline_depth)
         )
+        # Count of submissions currently in flight on the proxy: the
+        # feed loop flushes at flush_interval_s only when this is 0
+        # (idle -> latency priority); while dispatches are in flight it
+        # accumulates bigger quanta up to flush_max_age_s (throughput
+        # priority — bigger quanta combine harder and amortize the
+        # per-flush fixed costs).
+        self._busy_lock = threading.Lock()
+        self._inflight_busy = 0
+        # v2 wire: flow-descriptor dictionary (parallel/flowdict.py).
+        # Host side assigns stable device-table slots; the device table
+        # itself is created lazily ON device (zeros jit — a host-side
+        # 48MB/device upload would saturate the link it exists to save).
+        self._flow_dict = (
+            make_flow_dict(cfg.flow_dict_slots)
+            if cfg.transfer_packed and cfg.wire_flow_dict
+            else None
+        )
+        self._fd_lock = threading.Lock()
+        self._desc_table: Any = None
+        # Bumped ONLY by failure resyncs (not by capacity-overflow
+        # generation clears, which keep the device table intact and are
+        # FIFO-safe for in-flight batches): a queued batch whose epoch
+        # predates a resync references a table that no longer exists
+        # and must drop itself rather than gather zeroed descriptors.
+        self._fd_epoch = 0
 
         self._ident_lock = threading.Lock()
         self.ident = IdentityMap.zeros(cfg.identity_slots)
@@ -143,6 +171,9 @@ class SketchEngine:
         self._snap_flight = threading.Lock()
         self._snap_cache: dict[str, Any] | None = None
         self._snap_time = 0.0
+        # Previous window's stacked device results awaiting harvest
+        # (proxy thread only).
+        self._pending_win: Any = None
         self.last_window: dict[str, np.ndarray] = {}
         self._state_lock = threading.Lock()
         self.started = threading.Event()
@@ -293,13 +324,30 @@ class SketchEngine:
             np.zeros((0, NUM_FIELDS), np.uint32), now_s=1,
             record_metrics=False,
         )
-        if self.cfg.feed_coalesce_windows > 1:
-            from retina_tpu.parallel.partition import _next_bucket
-
-            packed = bool(self.cfg.transfer_packed)
-            coal_cap = (
-                self.cfg.batch_capacity * self.cfg.feed_coalesce_windows
+        coal_cap = (
+            self.cfg.batch_capacity
+            * max(1, self.cfg.feed_coalesce_windows)
+        )
+        if self._flow_dict is not None:
+            # Flow-dict mode: warm the new/known ingest grid. Steady
+            # state puts the known bucket near quantum/combine_ratio
+            # (often BELOW batch_capacity) and the new bucket at the
+            # minimum, but warm the full upper grid so a churn burst
+            # never cold-compiles on the proxy thread mid-feed.
+            grid = {self._wire_bucket(0)}
+            b = max(
+                self.cfg.batch_capacity // 8,
+                self.cfg.transfer_min_bucket,
             )
+            grid.add(self._wire_bucket(b))
+            while b < coal_cap:
+                b = min(_next_bucket(b + 1), coal_cap)
+                grid.add(b)
+            for b in sorted(grid):
+                run_on_device(self._ingest_new_fn, b)
+                run_on_device(self._ingest_known_fn, b)
+        elif self.cfg.feed_coalesce_windows > 1:
+            packed = bool(self.cfg.transfer_packed)
             b = self.cfg.batch_capacity
             seen = set()
             while b < coal_cap:
@@ -402,6 +450,373 @@ class SketchEngine:
             self._pad_cache[key] = fn
         return fn
 
+    # -- v2 wire: flow-descriptor dictionary path ---------------------
+    def _flowdict_resync(self) -> None:
+        """Invalidate host dict + device table together after a failure
+        that may have desynced them (one descriptor re-upload burst, no
+        wrong data) and fence off in-flight batches built against the
+        old table."""
+        with self._fd_lock:
+            self._flow_dict.clear()
+            self._fd_epoch += 1
+        self._desc_table = None
+
+    def _ensure_desc_table(self):
+        """(proxy thread) Device descriptor table, created by a zeros
+        jit ON device — never uploaded from host."""
+        if self._desc_table is None:
+            from functools import partial as _partial
+
+            from retina_tpu.parallel.wire import PACKED_FIELDS
+
+            shape = (
+                self.n_devices, self.cfg.flow_dict_slots, PACKED_FIELDS,
+            )
+
+            @_partial(jax.jit, out_shardings=self._rec_sharding)
+            def mk():
+                return jnp.zeros(shape, jnp.uint32)
+
+            self._desc_table = mk()
+        return self._desc_table
+
+    @staticmethod
+    def _slice_windows(full, nv_i32, bucket: int, cap: int):
+        """(traced) Slice a (D, bucket, 16) array into step windows of
+        the static (D, cap, 16) shape with per-window validity counts
+        (same contract as _ingest_fn's window loop)."""
+        n_win = max(1, -(-bucket // cap))
+        wins, nvs = [], []
+        for w in range(n_win):
+            lo = w * cap
+            hi = min(lo + cap, bucket)
+            c = full[:, lo:hi]
+            if hi - lo < cap:
+                c = jnp.pad(c, ((0, 0), (0, cap - (hi - lo)), (0, 0)))
+            wins.append(c)
+            nvs.append(
+                jnp.clip(nv_i32 - lo, 0, hi - lo).astype(jnp.uint32)
+            )
+        return tuple(wins), tuple(nvs)
+
+    def _ingest_new_fn(self, bucket: int):
+        """Per-bucket jit for NEW flow descriptors: (D, bucket, 13) wire
+        of [table_id | 12 packed lanes] + meta + descriptor table ->
+        scatter the lanes into the table (donated; id 0 is the overflow
+        sentinel slot, sacrificial), unpack, slice into step windows.
+
+        Reference analog: the first packet of a flow inserting its key
+        into the kernel map (conntrack.c ct_create entry) — descriptor
+        becomes resident; only counters travel afterwards.
+        """
+        key = ("new", bucket)
+        fn = self._pad_cache.get(key)
+        if fn is None:
+            cap = self.cfg.batch_capacity
+            n_win = max(1, -(-bucket // cap))
+            from functools import partial as _partial
+
+            from retina_tpu.parallel.wire import (
+                PACKED_FIELDS, unpack_records_device,
+            )
+
+            out_sh = (
+                (self._rec_sharding,) * n_win,
+                (self._rec_sharding,) * n_win,
+                self._replicated,
+                self._replicated,
+                self._rec_sharding,
+            )
+
+            @_partial(
+                jax.jit, out_shardings=out_sh, donate_argnums=(2,)
+            )
+            def ingest(wire, meta, table):
+                ids = wire[..., 0]
+                lanes = wire[..., 1:]
+                d_idx = jnp.arange(lanes.shape[0])[:, None]
+                table = table.at[d_idx, ids].set(lanes)
+                full = unpack_records_device(lanes, meta[0], meta[1])
+                nv = meta[4:].astype(jnp.int32)
+                wins, nvs = SketchEngine._slice_windows(
+                    full, nv, bucket, cap
+                )
+                return wins, nvs, meta[2], meta[3], table
+
+            fn = ingest.lower(
+                jax.ShapeDtypeStruct(
+                    (self.n_devices, bucket, PACKED_FIELDS + 1),
+                    jnp.uint32, sharding=self._rec_sharding,
+                ),
+                jax.ShapeDtypeStruct(
+                    (4 + self.n_devices,), jnp.uint32,
+                    sharding=self._replicated,
+                ),
+                jax.ShapeDtypeStruct(
+                    (
+                        self.n_devices, self.cfg.flow_dict_slots,
+                        PACKED_FIELDS,
+                    ),
+                    jnp.uint32, sharding=self._rec_sharding,
+                ),
+            ).compile()
+            self._pad_cache[key] = fn
+        return fn
+
+    def _ingest_known_fn(self, bucket: int):
+        """Per-bucket jit for KNOWN flows: (D, bucket, 4) wire of
+        [table_id, packets, bytes, ts_rel] + meta + descriptor table ->
+        gather the resident 12-lane descriptors from HBM, overlay the
+        per-quantum counters, unpack, slice into step windows. 16 bytes
+        per flow row on the link instead of 48.
+
+        Reference analog: the kernel map hit path — established flows
+        move counters only (conntrack.c ct_process_packet accumulate).
+        """
+        key = ("known", bucket)
+        fn = self._pad_cache.get(key)
+        if fn is None:
+            cap = self.cfg.batch_capacity
+            n_win = max(1, -(-bucket // cap))
+            from functools import partial as _partial
+
+            from retina_tpu.parallel.wire import (
+                PACKED_FIELDS, unpack_records_device,
+            )
+
+            out_sh = (
+                (self._rec_sharding,) * n_win,
+                (self._rec_sharding,) * n_win,
+                self._replicated,
+                self._replicated,
+            )
+
+            @_partial(jax.jit, out_shardings=out_sh)
+            def ingest(wire, meta, table):
+                ids = wire[..., 0]
+                d_idx = jnp.arange(wire.shape[0])[:, None]
+                desc = table[d_idx, ids]  # (D, bucket, 12)
+                desc = desc.at[..., 6].set(wire[..., 1])  # PACKETS
+                desc = desc.at[..., 5].set(wire[..., 2])  # BYTES
+                desc = desc.at[..., 0].set(wire[..., 3])  # TS_REL
+                full = unpack_records_device(desc, meta[0], meta[1])
+                nv = meta[4:].astype(jnp.int32)
+                wins, nvs = SketchEngine._slice_windows(
+                    full, nv, bucket, cap
+                )
+                return wins, nvs, meta[2], meta[3]
+
+            fn = ingest.lower(
+                jax.ShapeDtypeStruct(
+                    (self.n_devices, bucket, 4), jnp.uint32,
+                    sharding=self._rec_sharding,
+                ),
+                jax.ShapeDtypeStruct(
+                    (4 + self.n_devices,), jnp.uint32,
+                    sharding=self._replicated,
+                ),
+                jax.ShapeDtypeStruct(
+                    (
+                        self.n_devices, self.cfg.flow_dict_slots,
+                        PACKED_FIELDS,
+                    ),
+                    jnp.uint32, sharding=self._rec_sharding,
+                ),
+            ).compile()
+            self._pad_cache[key] = fn
+        return fn
+
+    def _wire_bucket(self, n_max: int) -> int:
+        cap_total = self.cfg.batch_capacity * max(
+            1, self.cfg.feed_coalesce_windows
+        )
+        return min(
+            _next_bucket(max(n_max, self.cfg.transfer_min_bucket)),
+            cap_total,
+        )
+
+    def _dispatch_flowdict(
+        self, sb: "ShardedBatch", now_s: int, n_raw: int,
+        sync: bool, record_metrics: bool,
+    ) -> None:
+        """Flow-dictionary dispatch: split the partitioned batch into
+        new-descriptor rows (full 12-lane upload + table insert) and
+        known rows (16-byte counter tuples against the resident table).
+        Both ride one proxy submission, FIFO-ordered so inserts land
+        before gathers."""
+        from retina_tpu.parallel.wire import (
+            batch_ts_base, pack_records, ts_rel,
+        )
+
+        with self._ident_lock:
+            ident = self.ident
+            fmap = self.filter_map
+        m = get_metrics()
+        lost = sb.lost
+        D = self.n_devices
+        with self._fd_lock:
+            per_dev = []
+            for d in range(D):
+                nv = int(sb.n_valid[d])
+                rows = sb.records[d, :nv]
+                ids, is_new = self._flow_dict.lookup_or_assign(rows)
+                per_dev.append((rows, ids, is_new))
+            epoch = self._fd_epoch
+        base = batch_ts_base(sb.records)
+        n_new = [int(x[2].sum()) for x in per_dev]
+        n_known = [len(x[0]) - nn for x, nn in zip(per_dev, n_new)]
+        Bn = self._wire_bucket(max(n_new) if n_new else 0)
+        Bk = self._wire_bucket(max(n_known) if n_known else 0)
+        new_wire = np.zeros((D, Bn, 13), np.uint32)
+        known_wire = np.zeros((D, Bk, 4), np.uint32)
+        nv_new = np.zeros((D,), np.uint32)
+        nv_known = np.zeros((D,), np.uint32)
+        for d, (rows, ids, is_new) in enumerate(per_dev):
+            rn, idn = rows[is_new], ids[is_new]
+            rk, idk = rows[~is_new], ids[~is_new]
+            if len(rn) > Bn or len(rk) > Bk:
+                # Unreachable from in-tree callers (partition capacity
+                # == the _wire_bucket cap). Dropping new rows here
+                # would be CORRUPTION, not loss: their descriptors are
+                # already registered host-side, so later quanta would
+                # reference never-written table slots. Fail loudly; the
+                # caller's resync handler rebuilds both sides.
+                raise RuntimeError(
+                    f"flow-dict wire overflow: {len(rn)}/{Bn} new, "
+                    f"{len(rk)}/{Bk} known rows on device {d}"
+                )
+            if len(rn):
+                packed12, _, _ = pack_records(rn, base=base)
+                new_wire[d, : len(rn), 0] = idn
+                new_wire[d, : len(rn), 1:] = packed12
+            if len(rk):
+                known_wire[d, : len(rk), 0] = idk
+                known_wire[d, : len(rk), 1] = rk[:, F.PACKETS]
+                known_wire[d, : len(rk), 2] = rk[:, F.BYTES]
+                known_wire[d, : len(rk), 3] = ts_rel(rk, base)
+            nv_new[d] = len(rn)
+            nv_known[d] = len(rk)
+        if record_metrics:
+            if lost:
+                m.lost_events.labels(
+                    stage="partition", plugin="engine"
+                ).inc(lost)
+            m.transfer_bytes.inc(new_wire.nbytes + known_wire.nbytes)
+        b_lo = np.uint32(base & np.uint64(0xFFFFFFFF))
+        b_hi = np.uint32(base >> np.uint64(32))
+        meta_new = np.empty((4 + D,), np.uint32)
+        meta_new[0], meta_new[1] = b_lo, b_hi
+        meta_new[2] = np.uint32(int(now_s) & 0xFFFFFFFF)
+        meta_new[3] = np.uint32(int(lost) & 0xFFFFFFFF)
+        meta_new[4:] = nv_new
+        have_new = bool(nv_new.any())
+        have_known = bool(nv_known.any())
+        meta_known = meta_new.copy()
+        # Host losses fold into the device totals exactly once: on the
+        # new side when it runs, else on the known side.
+        meta_known[3] = 0 if have_new else meta_new[3]
+        meta_known[4:] = nv_known
+        n_events = int(sb.events)
+        n_valid_total = int(nv_new.sum() + nv_known.sum())
+
+        def xfer_and_step():
+            # A failure resync after this batch was built invalidated
+            # the table its ids reference — drop rather than gather
+            # zeroed descriptors (FIFO makes ordinary overflow clears
+            # safe; only resyncs bump the epoch).
+            with self._fd_lock:
+                if self._fd_epoch != epoch:
+                    if record_metrics:
+                        m.lost_events.labels(
+                            stage="dispatch", plugin="engine"
+                        ).inc(n_events)
+                    self.log.warning(
+                        "dropping in-flight flow-dict batch from "
+                        "pre-resync epoch"
+                    )
+                    return
+            self._device_consts()
+            table = self._ensure_desc_table()
+            t_x0 = time.perf_counter()
+            sides = []
+            # Skip a side with zero valid rows outright: steady state
+            # has almost-no new flows, cold start almost-no known —
+            # half the transfers and steps on the hot path either way.
+            if have_new:
+                new_dev = jax.device_put(new_wire, self._rec_sharding)
+                mn_dev = jax.device_put(meta_new, self._replicated)
+                wins, nvs, now_dev, lost_dev, table = (
+                    self._ingest_new_fn(Bn)(new_dev, mn_dev, table)
+                )
+                self._desc_table = table
+                sides.append((wins, nvs, now_dev, lost_dev))
+            if have_known:
+                known_dev = jax.device_put(
+                    known_wire, self._rec_sharding
+                )
+                mk_dev = jax.device_put(meta_known, self._replicated)
+                wins, nvs, now_dev, lost_dev = self._ingest_known_fn(
+                    Bk
+                )(known_dev, mk_dev, table)
+                sides.append((wins, nvs, now_dev, lost_dev))
+            t0 = time.perf_counter()
+            n_steps = 0
+            with self._state_lock:
+                st = self.state
+                first = True
+                for wins, nvs, now_dev, lost_dev in sides:
+                    for w in range(len(wins)):
+                        st, _ = self.sharded.step(
+                            st, wins[w], nvs[w], now_dev, ident,
+                            self._api_dev, filter_map=fmap,
+                            # meta_known carries lost=0, so folding on
+                            # the FIRST side that runs counts host
+                            # losses once whichever sides are present.
+                            lost=lost_dev if first else self._zero_u32,
+                        )
+                        first = False
+                        n_steps += 1
+                self.state = st
+            if record_metrics:
+                m.transfer_seconds.observe(t0 - t_x0)
+                m.device_step_seconds.observe(time.perf_counter() - t0)
+                m.device_batch_fill.set(
+                    n_valid_total
+                    / max(D * self.cfg.batch_capacity * n_steps, 1)
+                )
+                self._steps += n_steps
+                self._events_in += n_raw
+
+        if not (have_new or have_known):
+            return  # nothing valid (pure padding batch)
+
+        if sync:
+            run_on_device(xfer_and_step)
+            return
+
+        def safe_xfer_and_step():
+            try:
+                xfer_and_step()
+            except Exception:
+                self.log.exception("flow-dict device step failed")
+                get_metrics().lost_events.labels(
+                    stage="device", plugin="engine"
+                ).inc(n_events)
+                # The donated table may be gone and the host dict no
+                # longer matches it — resync by rebuilding both (one
+                # re-upload burst, no wrong data); queued batches from
+                # this epoch self-drop.
+                self._flowdict_resync()
+            finally:
+                with self._busy_lock:
+                    self._inflight_busy -= 1
+                self._inflight.release()
+
+        self._inflight.acquire()
+        with self._busy_lock:
+            self._inflight_busy += 1
+        submit_on_device(safe_xfer_and_step)
+
     def _dispatch_sharded(
         self, sb: "ShardedBatch", now_s: int, n_raw: int,
         sync: bool = True, record_metrics: bool = True,
@@ -417,6 +832,34 @@ class SketchEngine:
         the in-flight semaphore, so transfers run back-to-back on the
         link while this thread packs the next quantum.
         """
+        # The dictionary pays off per ROW saved; a tiny flush (idle
+        # agent, interval flush) is cheaper as one plain transfer than
+        # as a new/known pair of dispatches. Plain and dict flushes
+        # interleave soundly: a plain flush simply ships full rows and
+        # leaves the dictionary untouched.
+        if self._flow_dict is not None and int(
+            sb.n_valid.sum()
+        ) >= self.cfg.transfer_min_bucket:
+            try:
+                self._dispatch_flowdict(
+                    sb, now_s, n_raw, sync, record_metrics
+                )
+            except Exception:
+                # ANY failure after lookup_or_assign may leave
+                # descriptors registered host-side whose lanes never
+                # reached the device table — later "known" references
+                # would gather zeros (silent corruption). Rebuild both
+                # sides; in-flight batches from before the reset
+                # self-drop via the epoch check in their closures.
+                self._flowdict_resync()
+                if not sync:
+                    get_metrics().lost_events.labels(
+                        stage="dispatch", plugin="engine"
+                    ).inc(int(sb.events) + int(sb.lost))
+                    self.log.exception("flow-dict dispatch failed")
+                    return
+                raise
+            return
         with self._ident_lock:
             ident = self.ident
             fmap = self.filter_map
@@ -503,15 +946,20 @@ class SketchEngine:
                     stage="device", plugin="engine"
                 ).inc(n_events)
             finally:
+                with self._busy_lock:
+                    self._inflight_busy -= 1
                 self._inflight.release()
 
         self._inflight.acquire()
+        with self._busy_lock:
+            self._inflight_busy += 1
         submit_on_device(safe_xfer_and_step)
 
-    def _win_readback(self, win) -> dict[str, np.ndarray]:
+    def _win_stack(self, win):
         """(proxy thread) Stack the 3 per-dimension window outputs into
         one array so the device->host readback is ONE transfer (per-leaf
-        device_get costs a link round-trip per array)."""
+        device_get costs a link round-trip per array) and start the copy
+        moving without blocking."""
         stacked = jnp.stack(
             [
                 jnp.asarray(win["entropy_bits"], jnp.float32),
@@ -519,17 +967,80 @@ class SketchEngine:
                 jnp.asarray(win["zscore"], jnp.float32),
             ]
         )
-        host = np.asarray(jax.device_get(stacked))
+        try:
+            stacked.copy_to_host_async()
+        except Exception:  # backend without async copy: harvest blocks
+            pass
+        return stacked
+
+    def _win_readback(self, win) -> dict[str, np.ndarray]:
+        host = np.asarray(jax.device_get(self._win_stack(win)))
         return {
             "entropy_bits": host[0],
             "anomaly": host[1],
             "zscore": host[2],
         }
 
+    def _publish_window(self, win_host: dict[str, np.ndarray]) -> None:
+        self.last_window = win_host
+        m = get_metrics()
+        dims = ["src_ip", "dst_ip", "dst_port"]
+        for i, dim in enumerate(dims):
+            m.entropy_bits.labels(dimension=dim).set(
+                float(win_host["entropy_bits"][i])
+            )
+            m.anomaly_flag.labels(dimension=dim).set(
+                float(win_host["anomaly"][i])
+            )
+            m.anomaly_zscore.labels(dimension=dim).set(
+                float(win_host["zscore"][i])
+            )
+            if win_host["anomaly"][i]:
+                # Counter survives scrape cadence: a 0.2s anomalous
+                # window must be visible at a 30s scrape.
+                m.anomaly_windows.labels(dimension=dim).inc()
+
+    def _harvest_window(self) -> None:
+        """(proxy thread) Publish the PREVIOUS close's window results.
+        The device_get here is ~free: the async copy started at close
+        time and a whole window interval has passed — the synchronous
+        readback used to park the proxy thread for a full link
+        round-trip behind the queued compute (~70% of proxy time under
+        load, measured via /debug/pprof)."""
+        pending = self._pending_win
+        if pending is None:
+            return
+        self._pending_win = None
+        try:
+            host = np.asarray(jax.device_get(pending))
+            self._publish_window({
+                "entropy_bits": host[0],
+                "anomaly": host[1],
+                "zscore": host[2],
+            })
+        except Exception:
+            self.log.exception("window readback failed")
+
     def _close_window(self) -> None:
+        """End the entropy/anomaly window (self-proxying: the body —
+        including the harvest's device_get — always executes on the
+        device-proxy thread, whatever thread calls this)."""
+        run_on_device(self._close_window_impl)
+
+    def _close_window_impl(self) -> None:
         """(proxy thread) End the entropy/anomaly window. Runs as a
         fire-and-forget proxy submission from the dispatch worker, so it
-        stays ordered after the step submissions that fed the window."""
+        stays ordered after the step submissions that fed the window.
+
+        The results of THIS close publish at the NEXT window tick
+        (harvest-first): the close dispatches end_window and starts an
+        async device->host copy, but never waits on it — a synchronous
+        readback parks the proxy thread for a link round-trip behind
+        all queued compute, which measured as ~70% of proxy time under
+        load. One window of gauge lag is invisible at any real scrape
+        cadence."""
+        # Publish the previous close's results first (copy long done).
+        self._harvest_window()
         # Idle fast path: end_window SKIPS empty windows on-device (no
         # flag, no baseline update — AnomalyEWMA.observe active gating),
         # so when nothing arrived since the last close the dispatch +
@@ -554,30 +1065,15 @@ class SketchEngine:
                 self.state, win = self.sharded.end_window(
                     self.state, self._zthresh
                 )
-            return self._win_readback(win)
+            return self._win_stack(win)
 
-        win_host = run_on_device(close)
-        # Advance only after a SUCCESSFUL close: if end_window raised,
-        # the next tick must retry this window, not skip it forever.
+        stacked = run_on_device(close)
+        # Advance only after a SUCCESSFUL dispatch: if end_window
+        # raised, the next tick must retry this window, not skip it
+        # forever.
         self._closed_events_in = ingested
-        self.last_window = win_host
-        m = get_metrics()
-        m.windows_closed.inc()
-        dims = ["src_ip", "dst_ip", "dst_port"]
-        for i, dim in enumerate(dims):
-            m.entropy_bits.labels(dimension=dim).set(
-                float(self.last_window["entropy_bits"][i])
-            )
-            m.anomaly_flag.labels(dimension=dim).set(
-                float(self.last_window["anomaly"][i])
-            )
-            m.anomaly_zscore.labels(dimension=dim).set(
-                float(self.last_window["zscore"][i])
-            )
-            if self.last_window["anomaly"][i]:
-                # Counter survives scrape cadence: a 0.2s anomalous
-                # window must be visible at a 30s scrape.
-                m.anomaly_windows.labels(dimension=dim).inc()
+        self._pending_win = stacked
+        get_metrics().windows_closed.inc()
 
     def _submit_close_window(self) -> None:
         """Fire-and-forget window close, bounded like step submissions
@@ -683,6 +1179,9 @@ class SketchEngine:
                 self._dispatch_sharded(item[1], item[2], item[3])
             else:
                 try:
+                    # _close_window self-proxies: the close (and the
+                    # harvest's device_get) never runs concurrently
+                    # with proxied step dispatches.
                     self._close_window()
                 except Exception:
                     self.log.exception("window close failed")
@@ -740,7 +1239,19 @@ class SketchEngine:
                         flush()
                 now = time.monotonic()
                 if n_pending and now - last_flush >= self.cfg.flush_interval_s:
-                    flush()
+                    # Interval flushes serve LATENCY and only make sense
+                    # when the dispatch pipeline is idle; with work in
+                    # flight, keep accumulating (bigger quanta combine
+                    # harder and amortize per-flush fixed costs) up to
+                    # the hard age bound. Without this gate the fast
+                    # async pipeline settles into many tiny flushes
+                    # whose fixed costs cap throughput.
+                    with self._busy_lock:
+                        busy = self._inflight_busy
+                    if busy == 0 or (
+                        now - last_flush >= self.cfg.flush_max_age_s
+                    ):
+                        flush()
                 if now >= next_window:
                     submit(("window", None, 0, 0))
                     next_window = now + self.cfg.window_seconds
@@ -763,6 +1274,13 @@ class SketchEngine:
                 self.log.error(
                     "device proxy did not drain within 60s at shutdown"
                 )
+            else:
+                # Publish the final window's pending readback so
+                # shutdown gauges aren't one window stale.
+                try:
+                    run_on_device(self._harvest_window)
+                except Exception:
+                    self.log.exception("final window harvest failed")
 
     # -- scrape-time readout -----------------------------------------
     def snapshot(self, max_age_s: float = 0.5) -> dict[str, Any]:

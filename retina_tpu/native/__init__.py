@@ -62,7 +62,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         src_mtime = max(
             os.path.getmtime(os.path.join(_dir, f))
             for f in ("decoder.cpp", "ring.cpp", "combine.cpp",
-                      "afpacket.cpp")
+                      "afpacket.cpp", "flowdict.cpp")
         )
         if (not os.path.exists(_so_path)
                 or os.path.getmtime(_so_path) < src_mtime):
@@ -85,6 +85,22 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.rt_combine.argtypes = [
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.rt_flowdict_new.restype = ctypes.c_void_p
+        lib.rt_flowdict_new.argtypes = [ctypes.c_uint32]
+        lib.rt_flowdict_free.restype = None
+        lib.rt_flowdict_free.argtypes = [ctypes.c_void_p]
+        lib.rt_flowdict_clear.restype = None
+        lib.rt_flowdict_clear.argtypes = [ctypes.c_void_p]
+        lib.rt_flowdict_len.restype = ctypes.c_uint32
+        lib.rt_flowdict_len.argtypes = [ctypes.c_void_p]
+        lib.rt_flowdict_generation.restype = ctypes.c_uint32
+        lib.rt_flowdict_generation.argtypes = [ctypes.c_void_p]
+        lib.rt_flowdict_assign.restype = ctypes.c_uint32
+        lib.rt_flowdict_assign.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint8),
         ]
         lib.rt_afp_open.restype = ctypes.c_void_p
         lib.rt_afp_open.argtypes = [
@@ -177,6 +193,60 @@ def combine_native(records: np.ndarray) -> Optional[np.ndarray]:
     if g == n:
         return records
     return out[:g]
+
+
+class NativeFlowDict:
+    """Persistent descriptor->id dictionary (flowdict.cpp) — the
+    GIL-released twin of parallel.flowdict.HostFlowDict (same contract,
+    cross-checked by tests). Raises RuntimeError if the native library
+    is unavailable; callers fall back to the Python dict."""
+
+    def __init__(self, capacity: int = 1 << 18):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.capacity = int(capacity)
+        self._h = lib.rt_flowdict_new(self.capacity)
+        if not self._h:
+            raise RuntimeError("flowdict allocation failed")
+
+    @property
+    def generation(self) -> int:
+        return int(self._lib.rt_flowdict_generation(self._h))
+
+    def __len__(self) -> int:
+        return int(self._lib.rt_flowdict_len(self._h))
+
+    def clear(self) -> None:
+        self._lib.rt_flowdict_clear(self._h)
+
+    def lookup_or_assign(self, records: np.ndarray):
+        n = len(records)
+        ids = np.zeros(n, np.uint32)
+        is_new = np.zeros(n, np.uint8)
+        if n:
+            if not records.flags.c_contiguous:
+                records = np.ascontiguousarray(records)
+            self._lib.rt_flowdict_assign(
+                self._h,
+                records.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                n,
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                is_new.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            )
+        return ids, is_new.astype(bool)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.rt_flowdict_free(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class AfPacketRing:

@@ -110,8 +110,13 @@ def partition_events(
     consume the ShardedBatch (e.g. ``jax.device_put``, as the engine
     does) before reusing the input buffer. Multi-device output is always
     a fresh array.
+
+    Hashing and loss weighting use schema columns only; trailing
+    columns beyond NUM_FIELDS (none in-tree today) would ride along
+    untouched.
     """
-    assert records.ndim == 2 and records.shape[1] == NUM_FIELDS
+    assert records.ndim == 2 and records.shape[1] >= NUM_FIELDS
+    width = records.shape[1]
 
     def bucket_for(n_max: int) -> int:
         if min_bucket is None:
@@ -128,9 +133,9 @@ def partition_events(
         b = bucket_for(n)
         if n == b:
             out = np.ascontiguousarray(records[:n], np.uint32)
-            out = out.reshape(1, b, NUM_FIELDS)
+            out = out.reshape(1, b, width)
         else:
-            out = np.zeros((1, b, NUM_FIELDS), np.uint32)
+            out = np.zeros((1, b, width), np.uint32)
             out[0, :n] = records[:n]
         return ShardedBatch(records=out, n_valid=np.array([n], np.uint32),
                             lost=lost, events=kept)
@@ -141,7 +146,7 @@ def partition_events(
         dev = canonical_conn_hash(records) % np.uint32(n_devices)
         counts = np.bincount(dev, minlength=n_devices)
         b = bucket_for(int(min(counts.max(), capacity)))
-        out = np.zeros((n_devices, b, NUM_FIELDS), np.uint32)
+        out = np.zeros((n_devices, b, width), np.uint32)
         total = int(records[:, F.PACKETS].astype(np.uint64).sum())
         for d in range(n_devices):
             rows = records[dev == d]
@@ -151,5 +156,5 @@ def partition_events(
             lost += int(rows[n:, F.PACKETS].astype(np.uint64).sum())
         kept = total - lost
     else:
-        out = np.zeros((n_devices, bucket_for(0), NUM_FIELDS), np.uint32)
+        out = np.zeros((n_devices, bucket_for(0), width), np.uint32)
     return ShardedBatch(records=out, n_valid=n_valid, lost=lost, events=kept)

@@ -53,26 +53,44 @@ PACKED_FIELDS = 12
 _U32 = np.uint64(0xFFFFFFFF)
 
 
+def batch_ts_base(records: np.ndarray) -> np.uint64:
+    """Minimum nonzero 64-bit timestamp of the batch (0 if none) — the
+    TS_REL base shared by every wire array cut from one flush."""
+    ts = (records[..., F.TS_HI].astype(np.uint64) << np.uint64(32)) | records[
+        ..., F.TS_LO
+    ].astype(np.uint64)
+    nz = ts[ts > 0]
+    return np.uint64(nz.min()) if len(nz) else np.uint64(0)
+
+
+def ts_rel(records: np.ndarray, base: np.uint64) -> np.ndarray:
+    """Biased relative timestamps: 1 + ns since ``base`` (saturating),
+    0 for unstamped rows — the TS_REL lane encoding."""
+    ts = (records[..., F.TS_HI].astype(np.uint64) << np.uint64(32)) | records[
+        ..., F.TS_LO
+    ].astype(np.uint64)
+    return np.where(
+        ts > 0,
+        np.minimum(ts - base, _U32 - np.uint64(1)) + np.uint64(1),
+        0,
+    ).astype(np.uint32)
+
+
 def pack_records(
-    records: np.ndarray,
+    records: np.ndarray, base: np.uint64 | None = None
 ) -> tuple[np.ndarray, np.uint32, np.uint32]:
     """(..., 16) u32 -> ((..., 12) u32, base_lo, base_hi).
 
     Works on (N, 16) host batches and (D, B, 16) sharded batches alike;
     padding rows (all zeros) pack to all-zero rows given base handling
-    below. The base is the minimum valid timestamp; zero-timestamp rows
-    (padding or sources that never stamp) keep TS_REL 0.
+    below. The base defaults to the minimum valid timestamp of THIS
+    array; pass one explicitly when several wire arrays cut from one
+    flush must share it. Zero-timestamp rows (padding or sources that
+    never stamp) keep TS_REL 0.
     """
-    ts = (records[..., F.TS_HI].astype(np.uint64) << np.uint64(32)) | records[
-        ..., F.TS_LO
-    ].astype(np.uint64)
-    nz = ts[ts > 0]
-    base = np.uint64(nz.min()) if len(nz) else np.uint64(0)
-    rel = np.where(
-        ts > 0,
-        np.minimum(ts - base, _U32 - np.uint64(1)) + np.uint64(1),
-        0,
-    ).astype(np.uint32)
+    if base is None:
+        base = batch_ts_base(records)
+    rel = ts_rel(records, base)
     out = np.empty(records.shape[:-1] + (PACKED_FIELDS,), np.uint32)
     out[..., 0] = rel
     out[..., 1] = records[..., F.SRC_IP]

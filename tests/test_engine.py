@@ -427,6 +427,11 @@ def test_pipelined_window_close_ordered_with_steps():
     stop.set()
     t.join(5.0)
     # close directly (loop window is 10s so it never fired): entropy of
-    # the fed window must be non-zero — steps preceded the close.
+    # the fed window must be non-zero — steps preceded the close. The
+    # close publishes at the NEXT tick (lagged readback), so harvest
+    # explicitly.
+    from retina_tpu.utils.device_proxy import run_on_device
+
     eng._close_window()
+    run_on_device(eng._harvest_window)
     assert float(eng.last_window["entropy_bits"][0]) > 0.0
