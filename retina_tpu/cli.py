@@ -12,7 +12,8 @@ entry point here, subcommand per role:
   observe   stream flows from the Hubble relay (hubble observe analog)
   top       heavy-hitter tables from a running agent
   config    print the effective layered configuration
-  trace     trace configuration (stub parity with cli/cmd/trace.go)
+  trace     sampled flow traces from the agent (module/traces; the
+            reference declares this verb but never built the pipeline)
   shell     drop into a network-debug shell (shell/ analog)
   version   print version
 """
@@ -471,8 +472,38 @@ def cmd_config(args: argparse.Namespace) -> int:
 
 # ---------------------------------------------------------- trace/shell
 def cmd_trace(args: argparse.Namespace) -> int:
-    # Parity with cli/cmd/trace.go:11-17 — a declared-but-stub command.
-    print("trace: not yet implemented (stub parity with the reference)")
+    """Show sampled flow traces from the agent (module/traces).
+
+    The reference declares this command but never implemented a trace
+    pipeline (cli/cmd/trace.go:11-17); here the agent samples matching
+    flows off the live record stream per the reconciled TracesSpec and
+    serves them through /debug/vars.
+    """
+    url = f"http://{args.server}/debug/vars"
+    doc = json.loads(urllib.request.urlopen(url, timeout=5).read())
+    if args.stats:
+        print(json.dumps(doc.get("traces_stats", {}), indent=2))
+        return 0
+    traces = doc.get("traces")
+    if traces is None:
+        print("agent does not expose traces", file=sys.stderr)
+        return 1
+    if not traces:
+        print("no trace targets configured "
+              "(apply a TracesConfiguration)")
+        return 0
+    for name, events in traces.items():
+        if args.target and name != args.target:
+            continue
+        print(f"== {name} ({len(events)} sampled)")
+        for e in events[-args.limit:]:
+            print(
+                f"  {e['ts']:.3f} {e['plugin']:>12} "
+                f"{e['src']}:{e['sport']} -> {e['dst']}:{e['dport']} "
+                f"proto={e['proto']} dir={e['direction']} "
+                f"verdict={e['verdict']} reason={e['drop_reason']} "
+                f"{e['packets']}pkt/{e['bytes']}B"
+            )
     return 0
 
 
@@ -695,7 +726,15 @@ def build_parser() -> argparse.ArgumentParser:
     cf.add_argument("--set", action="append", metavar="KEY=VAL")
     cf.set_defaults(fn=cmd_config)
 
-    tr = sub.add_parser("trace", help="trace configuration (stub)")
+    tr = sub.add_parser(
+        "trace", help="sampled flow traces from the agent"
+    )
+    tr.add_argument("--server", default="127.0.0.1:10093")
+    tr.add_argument("--target", default="",
+                    help="only this trace target")
+    tr.add_argument("--limit", type=int, default=50)
+    tr.add_argument("--stats", action="store_true",
+                    help="sampling stats instead of events")
     tr.set_defaults(fn=cmd_trace)
 
     sh = sub.add_parser("shell", help="network debug shell")

@@ -324,3 +324,24 @@ class TracesSpec:
 class TracesConfiguration:
     name: str = "default"
     spec: TracesSpec = dataclasses.field(default_factory=TracesSpec)
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "TracesConfiguration":
+        doc = yaml.safe_load(text) or {}
+        meta = doc.get("metadata", {})
+        s = doc.get("spec", {}) or {}
+        return cls(
+            name=meta.get("name", "default"),
+            spec=TracesSpec(
+                trace_targets=list(
+                    s.get("traceTargets", s.get("trace_targets", []))
+                ),
+                trace_points=list(
+                    s.get("tracePoints", s.get("trace_points", []))
+                ),
+                sampling_rate_per_mille=int(
+                    s.get("samplingRatePerMille",
+                          s.get("sampling_rate_per_mille", 0))
+                ),
+            ),
+        )

@@ -146,6 +146,62 @@ class Daemon:
                 pubsub=self.cm.pubsub,
                 dns_resolver=(dns_plugin.resolve if dns_plugin else None),
             )
+        # Per-flow trace sampling off the record stream (module/traces):
+        # idle until a TracesConfiguration reconcile names targets,
+        # queried via /debug/vars -> CLI `retina-tpu trace`.
+        from retina_tpu.module.traces import TracesModule
+
+        self.traces_module = TracesModule()
+        self.traces_module.attach(self.cm.engine)
+        # Agent-side CRD reconcile (the reference daemon watches its
+        # module CRDs itself, pkg/controllers/daemon): a list+watch
+        # bridge feeds a local store whose watches drive the metrics +
+        # traces modules — without this, only the OPERATOR process would
+        # see the CRs and the agent's modules would never reconcile.
+        self.crd_bridge = None
+        if cfg.kubeconfig or in_cluster_available():
+            try:
+                from retina_tpu.operator.bridge import KubeBridge
+                from retina_tpu.operator.store import CRDStore
+
+                crd_store = CRDStore()
+                crd_store.watch(
+                    "MetricsConfiguration", self._on_metrics_crd
+                )
+                crd_store.watch(
+                    "TracesConfiguration", self._on_traces_crd
+                )
+                self.crd_bridge = KubeBridge(
+                    crd_store, cfg.kubeconfig,
+                    namespace=cfg.kube_namespace,
+                )
+            except Exception as e:
+                self.log.warning("agent CRD bridge unavailable: %s", e)
+
+    # -- module CRD reconciles (agent side) ---------------------------
+    def _on_metrics_crd(self, event: str, conf: Any) -> None:
+        if self.metrics_module is None:
+            return
+        try:
+            if event == "deleted":
+                self.metrics_module.reconcile(
+                    MetricsConfiguration.default()
+                )
+            elif event == "applied":
+                self.metrics_module.reconcile(conf)
+        except Exception:
+            self.log.exception("metrics CRD reconcile failed")
+
+    def _on_traces_crd(self, event: str, conf: Any) -> None:
+        from retina_tpu.crd.types import TracesConfiguration
+
+        try:
+            if event == "deleted":
+                self.traces_module.reconcile(TracesConfiguration())
+            elif event == "applied":
+                self.traces_module.reconcile(conf)
+        except Exception:
+            self.log.exception("traces CRD reconcile failed")
 
     def start(self, stop: threading.Event) -> None:
         self.log.info(
@@ -154,6 +210,18 @@ class Daemon:
             self.cfg.enable_pod_level,
         )
         self.cm.init()
+        if self.cm.server is not None:
+            from retina_tpu.module.traces import MAX_EVENTS_PER_TARGET
+
+            self.cm.server.expose_var(
+                "traces",
+                lambda: self.traces_module.traces(
+                    limit=MAX_EVENTS_PER_TARGET
+                ),
+            )
+            self.cm.server.expose_var(
+                "traces_stats", self.traces_module.stats
+            )
         if self.monitoragent is not None:
             self.monitoragent.start(stop)
         if self.hubble is not None:
@@ -189,9 +257,13 @@ class Daemon:
             self.kubewatch.start()
         if self.ciliumwatch is not None:
             self.ciliumwatch.start()
+        if self.crd_bridge is not None:
+            self.crd_bridge.start()
         try:
             self.cm.start(stop)  # blocks until stop fires; runs shutdown
         finally:
+            if self.crd_bridge is not None:
+                self.crd_bridge.stop()
             if self.ciliumwatch is not None:
                 self.ciliumwatch.stop()
             if self.kubewatch is not None:

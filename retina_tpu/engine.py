@@ -701,7 +701,13 @@ class SketchEngine:
                 m.lost_events.labels(
                     stage="partition", plugin="engine"
                 ).inc(lost)
-            m.transfer_bytes.inc(new_wire.nbytes + known_wire.nbytes)
+            # Count only sides that actually cross the link (a skipped
+            # empty side never transfers) — this series is the wire-
+            # efficiency evidence and must not overcount.
+            m.transfer_bytes.inc(
+                (new_wire.nbytes if nv_new.any() else 0)
+                + (known_wire.nbytes if nv_known.any() else 0)
+            )
         b_lo = np.uint32(base & np.uint64(0xFFFFFFFF))
         b_hi = np.uint32(base >> np.uint64(32))
         meta_new = np.empty((4 + D,), np.uint32)

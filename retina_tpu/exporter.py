@@ -17,7 +17,6 @@ import threading
 from typing import Callable, Iterable
 
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
-from prometheus_client.exposition import generate_latest
 
 from retina_tpu.log import logger
 
@@ -190,7 +189,7 @@ class Exporter:
 
     def gather_hubble_text(self) -> bytes:
         """Exposition of the hubble registry only (:9965 mux)."""
-        return generate_latest(self.hubble_registry)
+        return render_exposition(self.hubble_registry)
 
     def new_hubble_gauge(self, name: str, labels: list[str],
                          help_: str = "") -> Gauge:

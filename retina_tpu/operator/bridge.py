@@ -52,9 +52,7 @@ KINDS: dict[str, Any] = {
     ),
     "TracesConfiguration": (
         "tracesconfigurations",
-        lambda doc: TracesConfiguration(
-            name=doc.get("metadata", {}).get("name", "default")
-        ),
+        lambda doc: TracesConfiguration.from_yaml(yaml.safe_dump(doc)),
     ),
 }
 

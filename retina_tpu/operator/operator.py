@@ -374,7 +374,14 @@ class Operator:
             self.metrics_module.reconcile(MetricsConfiguration.default())
 
     def _on_traces_conf(self, event: str, conf: TracesConfiguration) -> None:
-        if self.traces_module is not None and event == "applied":
+        if self.traces_module is None:
+            return
+        if event == "deleted":
+            # Deleting the CR must deactivate sampling (reconcile back
+            # to the empty default), mirroring _on_metrics_conf.
+            self.traces_module.reconcile(TracesConfiguration())
+            return
+        if event == "applied":
             self.traces_module.reconcile(conf)
 
     # -- endpoint publishing (pod_controller.go analog) ----------------

@@ -35,9 +35,44 @@ def test_version(capsys):
     assert buildinfo.VERSION in out
 
 
-def test_trace_stub(capsys):
-    assert main(["trace"]) == 0
-    assert "not yet implemented" in capsys.readouterr().out
+def test_trace_lists_sampled_flows(capsys):
+    """`trace` prints flows the agent's traces module sampled off the
+    record stream (leapfrogging the reference's never-built pipeline)."""
+    from retina_tpu.crd.types import TracesConfiguration, TracesSpec
+    from retina_tpu.events.schema import EventBuilder, ip_to_u32
+    from retina_tpu.module.traces import TracesModule
+    from retina_tpu.server import Server
+
+    tm = TracesModule()
+    tm.reconcile(TracesConfiguration(spec=TracesSpec(
+        trace_targets=[{"name": "web", "ips": ["10.0.0.5"],
+                        "ports": [80]}],
+    )))
+    b = EventBuilder(8)
+    b.add(src_ip=ip_to_u32("10.0.0.5"), dst_ip=ip_to_u32("10.0.0.9"),
+          src_port=1234, dst_port=80, packets=3, bytes_=900)
+    b.add(src_ip=ip_to_u32("10.9.9.9"), dst_ip=ip_to_u32("10.9.9.8"),
+          src_port=5, dst_port=6)
+    for batch in b.drain():
+        tm.observe(batch.records[: batch.n_valid], "packetparser")
+
+    srv = Server("127.0.0.1:0")
+    srv.expose_var("traces", lambda: tm.traces())
+    srv.expose_var("traces_stats", tm.stats)
+    srv.start()
+    try:
+        assert main(["trace", "--server",
+                     f"127.0.0.1:{srv.port}"]) == 0
+        out = capsys.readouterr().out
+        assert "== web" in out
+        assert "10.0.0.5:1234 -> 10.0.0.9:80" in out
+        assert "10.9.9.9" not in out  # unmatched flow not sampled
+        assert main(["trace", "--server",
+                     f"127.0.0.1:{srv.port}", "--stats"]) == 0
+        stats = capsys.readouterr().out
+        assert '"events_sampled": 1' in stats
+    finally:
+        srv.stop()
 
 
 def test_config_print_with_overrides(tmp_path, capsys):
