@@ -92,6 +92,10 @@ class MetricsSpec:
 @dataclasses.dataclass
 class MetricsConfiguration:
     name: str = "default"
+    # Kept for CRDStore keying (ns/name): without it, a CR outside the
+    # "default" namespace is stored under the wrong key and the bridge's
+    # post-LIST resync deletes it right after applying it.
+    namespace: str = "default"
     spec: MetricsSpec = dataclasses.field(default_factory=MetricsSpec)
 
     def validate(self) -> None:
@@ -133,13 +137,15 @@ class MetricsConfiguration:
             for c in spec_doc.get("contextOptions", [])
         ]
         ns_doc = spec_doc.get("namespaces", {}) or {}
+        meta = doc.get("metadata", {}) or {}
         obj = cls(
-            name=doc.get("metadata", {}).get("name", "default"),
+            name=meta.get("name", "default"),
+            namespace=meta.get("namespace") or "default",
             spec=MetricsSpec(
                 context_options=cos,
                 namespaces=MetricsNamespaces(
-                    include=ns_doc.get("include", []),
-                    exclude=ns_doc.get("exclude", []),
+                    include=ns_doc.get("include") or [],
+                    exclude=ns_doc.get("exclude") or [],
                 ),
             ),
         )
@@ -323,25 +329,32 @@ class TracesSpec:
 @dataclasses.dataclass
 class TracesConfiguration:
     name: str = "default"
+    namespace: str = "default"  # CRDStore keying (see MetricsConfiguration)
     spec: TracesSpec = dataclasses.field(default_factory=TracesSpec)
 
     @classmethod
     def from_yaml(cls, text: str) -> "TracesConfiguration":
+        # Null-tolerant throughout: a CR with `traceTargets:` left
+        # empty (YAML null) must parse as [], not raise inside the
+        # bridge's LIST loop — one malformed CR would wedge the whole
+        # kind's watch in a re-LIST spin.
         doc = yaml.safe_load(text) or {}
-        meta = doc.get("metadata", {})
+        meta = doc.get("metadata", {}) or {}
         s = doc.get("spec", {}) or {}
         return cls(
             name=meta.get("name", "default"),
+            namespace=meta.get("namespace") or "default",
             spec=TracesSpec(
                 trace_targets=list(
-                    s.get("traceTargets", s.get("trace_targets", []))
+                    s.get("traceTargets")
+                    or s.get("trace_targets") or []
                 ),
                 trace_points=list(
-                    s.get("tracePoints", s.get("trace_points", []))
+                    s.get("tracePoints") or s.get("trace_points") or []
                 ),
                 sampling_rate_per_mille=int(
-                    s.get("samplingRatePerMille",
-                          s.get("sampling_rate_per_mille", 0))
+                    s.get("samplingRatePerMille")
+                    or s.get("sampling_rate_per_mille") or 0
                 ),
             ),
         )

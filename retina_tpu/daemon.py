@@ -174,6 +174,11 @@ class Daemon:
                 self.crd_bridge = KubeBridge(
                     crd_store, cfg.kubeconfig,
                     namespace=cfg.kube_namespace,
+                    # Only the module CRs: Captures are the operator's
+                    # business, and N agents each LISTing every Capture
+                    # is pure apiserver load.
+                    kinds=["MetricsConfiguration",
+                           "TracesConfiguration"],
                 )
             except Exception as e:
                 self.log.warning("agent CRD bridge unavailable: %s", e)

@@ -186,11 +186,16 @@ class KubeBridge:
     API_BASE = f"/apis/{GROUP}/{VERSION}"
 
     def __init__(self, store: CRDStore, kubeconfig: str,
-                 namespace: str = "", retry_s: float = 2.0):
+                 namespace: str = "", retry_s: float = 2.0,
+                 kinds: list[str] | None = None):
+        """``kinds`` restricts the watch set (default: every KINDS
+        entry) — the agent daemon watches only its module CRs instead
+        of adding a redundant per-node Capture list+watch stream."""
         self._log = logger("kubebridge")
         self.store = store
         self.namespace = namespace
         self.retry_s = retry_s
+        self.kinds = list(kinds) if kinds is not None else list(KINDS)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.client = KubeClient(kubeconfig)
@@ -198,7 +203,22 @@ class KubeBridge:
     def _ingest(self, kind: str, event: str, item: dict) -> None:
         parse = KINDS[kind][1]
         if event in ("ADDED", "MODIFIED"):
-            self.store.apply(kind, parse(item))
+            try:
+                obj = parse(item)
+            except Exception as e:  # noqa: BLE001 — poison CR
+                # One malformed CR must not wedge the whole kind's
+                # watch (an exception escaping into list_watch's LIST
+                # loop re-LISTs forever and no CR of this kind ever
+                # reconciles again). Skip-and-log, like an admission
+                # rejection.
+                meta = item.get("metadata", {}) or {}
+                self._log.warning(
+                    "ignoring malformed %s %s/%s: %s", kind,
+                    meta.get("namespace", "default"),
+                    meta.get("name", "?"), e,
+                )
+                return
+            self.store.apply(kind, obj)
         elif event == "DELETED":
             meta = item.get("metadata", {})
             try:
@@ -247,7 +267,8 @@ class KubeBridge:
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
-        for kind, (plural, _) in KINDS.items():
+        for kind in self.kinds:
+            plural = KINDS[kind][0]
             t = threading.Thread(
                 target=self.client.list_watch,
                 args=(self.API_BASE, plural),

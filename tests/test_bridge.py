@@ -238,6 +238,59 @@ def fake_apiserver(tmp_path):
     httpd.shutdown()
 
 
+def test_crd_from_yaml_namespace_and_null_tolerance():
+    """Module CRs must keep metadata.namespace (CRDStore keys by ns/name
+    — dropping it makes the bridge's post-LIST resync delete every
+    non-default-namespace CR right after applying it) and must tolerate
+    YAML-null spec fields (a poison CR would otherwise wedge the whole
+    kind's watch in a re-LIST spin)."""
+    from retina_tpu.crd.types import (
+        MetricsConfiguration, TracesConfiguration,
+    )
+
+    t = TracesConfiguration.from_yaml(
+        "metadata:\n  name: foo\n  namespace: monitoring\n"
+        "spec:\n  traceTargets:\n  tracePoints:\n"
+        "  samplingRatePerMille:\n"
+    )
+    assert t.namespace == "monitoring"
+    assert t.spec.trace_targets == [] and t.spec.trace_points == []
+    assert t.spec.sampling_rate_per_mille == 0
+
+    m = MetricsConfiguration.from_yaml(
+        "metadata:\n  name: bar\n  namespace: monitoring\nspec: {}\n"
+    )
+    assert m.namespace == "monitoring"
+
+
+def test_kubebridge_poison_cr_skipped_not_wedged(fake_apiserver):
+    """A CR whose parse raises is skipped with a log; other CRs of the
+    same kind keep reconciling."""
+    from retina_tpu.operator.bridge import KINDS
+    from retina_tpu.operator.store import CRDStore
+
+    store = CRDStore()
+    bridge = KubeBridge(store, fake_apiserver, retry_s=5.0)
+    orig = KINDS["TracesConfiguration"]
+    try:
+        def parse(doc):
+            if doc.get("metadata", {}).get("name") == "poison":
+                raise ValueError("malformed")
+            return orig[1](doc)
+
+        KINDS["TracesConfiguration"] = (orig[0], parse)
+        bridge._ingest("TracesConfiguration", "ADDED",
+                       {"metadata": {"name": "poison"}})
+        bridge._ingest("TracesConfiguration", "ADDED",
+                       {"metadata": {"name": "good"},
+                        "spec": {"traceTargets": [{"name": "t"}]}})
+        got = store.list("TracesConfiguration")
+        assert [o.name for o in got] == ["good"]
+        assert got[0].spec.trace_targets == [{"name": "t"}]
+    finally:
+        KINDS["TracesConfiguration"] = orig
+
+
 def test_kubebridge_list_watch_and_status_patch(fake_apiserver):
     store = CRDStore()
     bridge = KubeBridge(store, fake_apiserver, retry_s=5.0)
