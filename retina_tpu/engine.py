@@ -744,23 +744,32 @@ class SketchEngine:
             self._device_consts()
             table = self._ensure_desc_table()
             t_x0 = time.perf_counter()
+            # ONE batched device_put for everything this flush moves:
+            # separate puts each pay a client round-trip on the tunnel
+            # backend.
+            host_bufs, shardings = [], []
+            if have_new:
+                host_bufs += [new_wire, meta_new]
+                shardings += [self._rec_sharding, self._replicated]
+            if have_known:
+                host_bufs += [known_wire, meta_known]
+                shardings += [self._rec_sharding, self._replicated]
+            devs = jax.device_put(tuple(host_bufs), tuple(shardings))
+            devs = list(devs)
             sides = []
             # Skip a side with zero valid rows outright: steady state
             # has almost-no new flows, cold start almost-no known —
             # half the transfers and steps on the hot path either way.
             if have_new:
-                new_dev = jax.device_put(new_wire, self._rec_sharding)
-                mn_dev = jax.device_put(meta_new, self._replicated)
+                new_dev, mn_dev = devs[0], devs[1]
+                devs = devs[2:]
                 wins, nvs, now_dev, lost_dev, table = (
                     self._ingest_new_fn(Bn)(new_dev, mn_dev, table)
                 )
                 self._desc_table = table
                 sides.append((wins, nvs, now_dev, lost_dev))
             if have_known:
-                known_dev = jax.device_put(
-                    known_wire, self._rec_sharding
-                )
-                mk_dev = jax.device_put(meta_known, self._replicated)
+                known_dev, mk_dev = devs[0], devs[1]
                 wins, nvs, now_dev, lost_dev = self._ingest_known_fn(
                     Bk
                 )(known_dev, mk_dev, table)
@@ -898,8 +907,11 @@ class SketchEngine:
         def xfer_and_step():
             self._device_consts()
             t_x0 = time.perf_counter()
-            wire_dev = jax.device_put(wire, self._rec_sharding)
-            meta_dev = jax.device_put(meta, self._replicated)
+            # One batched put (wire + meta): separate puts each pay a
+            # client round-trip on the tunnel backend.
+            wire_dev, meta_dev = jax.device_put(
+                (wire, meta), (self._rec_sharding, self._replicated)
+            )
             wins, nvs, now_dev, lost_dev = self._ingest_fn(
                 bucket, packed
             )(wire_dev, meta_dev)

@@ -289,8 +289,7 @@ class KubeBridge:
             t.start()
             self._threads.append(t)
         self._log.info("kube bridge watching %s at %s",
-                       ",".join(k for k, _ in KINDS.items()),
-                       self.client.server)
+                       ",".join(self.kinds), self.client.server)
 
     def stop(self) -> None:
         self._stop.set()
