@@ -662,6 +662,11 @@ class SketchEngine:
                 ids, is_new = self._flow_dict.lookup_or_assign(rows)
                 per_dev.append((rows, ids, is_new))
             epoch = self._fd_epoch
+            # Snapshot here so the published gauges are consistent with
+            # THIS batch's assignments (and no second lock acquisition
+            # on the hot path).
+            fd_entries = len(self._flow_dict)
+            fd_generation = self._flow_dict.generation
         base = batch_ts_base(sb.records)
         n_new = [int(x[2].sum()) for x in per_dev]
         n_known = [len(x[0]) - nn for x, nn in zip(per_dev, n_new)]
@@ -708,6 +713,12 @@ class SketchEngine:
                 (new_wire.nbytes if nv_new.any() else 0)
                 + (known_wire.nbytes if nv_known.any() else 0)
             )
+            # Dictionary self-observability: the known/new ratio IS the
+            # wire savings; generation bumps reveal capacity cycling.
+            m.wire_rows.labels(kind="new").inc(int(nv_new.sum()))
+            m.wire_rows.labels(kind="known").inc(int(nv_known.sum()))
+            m.flow_dict_entries.set(fd_entries)
+            m.flow_dict_generation.set(fd_generation)
         b_lo = np.uint32(base & np.uint64(0xFFFFFFFF))
         b_hi = np.uint32(base >> np.uint64(32))
         meta_new = np.empty((4 + D,), np.uint32)

@@ -82,6 +82,9 @@ class Metrics:
         self.lost_events = c(mn.LOST_EVENTS, [mn.L_STAGE, mn.L_PLUGIN])
         self.lost_table_entries = c(mn.LOST_TABLE_ENTRIES, [mn.L_TABLE])
         self.filter_push_failures = c(mn.FILTER_PUSH_FAILURES, [])
+        self.flow_dict_entries = g(mn.FLOW_DICT_ENTRIES, [])
+        self.flow_dict_generation = g(mn.FLOW_DICT_GENERATION, [])
+        self.wire_rows = c(mn.WIRE_ROWS, [mn.L_KIND])
         self.parsed_packets = c(mn.PARSED_PACKETS, [mn.L_PLUGIN])
         self.device_step_seconds = ex.new_histogram(
             mn.DEVICE_STEP_SECONDS,

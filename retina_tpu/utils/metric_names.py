@@ -70,6 +70,13 @@ LOST_TABLE_ENTRIES = PREFIX + "lost_table_entries_counter"
 # failure outlasting the backoff): the device filter set is stale until
 # the next successful push — invisible without this counter.
 FILTER_PUSH_FAILURES = PREFIX + "filter_push_failures_counter"
+# v2-wire flow dictionary self-observability: resident descriptors,
+# generation (bumps = capacity cycles or failure resyncs), and wire
+# rows by kind — known/new ratio IS the wire savings factor.
+FLOW_DICT_ENTRIES = PREFIX + "tpu_flow_dict_entries"
+FLOW_DICT_GENERATION = PREFIX + "tpu_flow_dict_generation"
+WIRE_ROWS = PREFIX + "tpu_wire_rows_counter"
+L_KIND = "kind"
 PARSED_PACKETS = PREFIX + "parsed_packets_counter"
 DEVICE_STEP_SECONDS = PREFIX + "tpu_step_seconds"
 DEVICE_BATCH_FILL = PREFIX + "tpu_batch_fill_ratio"

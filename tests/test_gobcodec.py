@@ -137,6 +137,84 @@ def test_gob_corrupt_length_prefix_raises_not_stalls():
         dec2.feed(bytes([0xFC]) + (2 << 30).to_bytes(4, "big"))
 
 
+def test_gob_decodes_floats_bools_strings_and_nested_types():
+    """Hand-built wire bytes for the non-Payload types a future Cilium
+    stream could carry: float (byte-reversed bits), bool, string, a
+    slice-of-int type, and a map type — decoded per the gob spec."""
+    from retina_tpu.sources.gobcodec import (
+        GobStructEncoder, _Writer, T_BOOL, T_FLOAT, T_STRING,
+    )
+
+    enc = GobStructEncoder(
+        "Mixed",
+        [("B", T_BOOL), ("F", T_FLOAT), ("S", T_STRING)],
+    )
+    wire = enc.encode({"B": True, "F": 17.0, "S": "héllo"})
+    got = GobStreamDecoder().feed(wire)
+    assert got == [{"B": True, "F": 17.0, "S": "héllo"}]
+
+    # Type descriptor for []int (SliceT), then a value [7, -3].
+    w = _Writer()
+    w.int_(-65)
+    w.uint(2)  # wireType field 1 = SliceT
+    w.uint(1)  # SliceType field 0 = CommonType
+    w.uint(1)
+    name = b"IntSlice"
+    w.uint(len(name)); w.bytes_(name)
+    w.uint(1); w.int_(65)
+    w.uint(0)  # end CommonType
+    w.uint(1); w.int_(2)  # Elem = int
+    w.uint(0)  # end SliceType
+    w.uint(0)  # end wireType
+    tdef = w.getvalue()
+    v = _Writer()
+    v.int_(65)
+    v.uint(0)  # singleton delta
+    v.uint(2)  # len
+    v.int_(7)
+    v.int_(-3)
+    val = v.getvalue()
+    f = _Writer()
+    f.uint(len(tdef))
+    body = f.getvalue() + tdef
+    f2 = _Writer()
+    f2.uint(len(val))
+    body += f2.getvalue() + val
+    assert GobStreamDecoder().feed(body) == [[7, -3]]
+
+    # Type descriptor for map[string]uint (MapT), then {"a": 1, "b": 2}.
+    from retina_tpu.sources.gobcodec import T_UINT
+
+    w = _Writer()
+    w.int_(-66)
+    w.uint(4)  # wireType field 3 = MapT
+    w.uint(1)  # MapType field 0 = CommonType
+    w.uint(1)
+    name = b"SUMap"
+    w.uint(len(name)); w.bytes_(name)
+    w.uint(1); w.int_(66)
+    w.uint(0)  # end CommonType
+    w.uint(1); w.int_(6)  # Key = string
+    w.uint(1); w.int_(T_UINT)  # Elem = uint
+    w.uint(0)  # end MapType
+    w.uint(0)  # end wireType
+    tdef = w.getvalue()
+    v = _Writer()
+    v.int_(66)
+    v.uint(0)  # singleton delta
+    v.uint(2)  # count
+    v.uint(1); v.bytes_(b"a"); v.uint(1)
+    v.uint(1); v.bytes_(b"b"); v.uint(2)
+    val = v.getvalue()
+    f3 = _Writer()
+    f3.uint(len(tdef))
+    body2 = f3.getvalue() + tdef
+    f4 = _Writer()
+    f4.uint(len(val))
+    body2 += f4.getvalue() + val
+    assert GobStreamDecoder().feed(body2) == [{"a": 1, "b": 2}]
+
+
 def test_gob_rejects_oversized_counts():
     # A hostile slice count must not allocate unbounded memory.
     dec = GobStreamDecoder()
