@@ -701,24 +701,10 @@ class SketchEngine:
                 known_wire[d, : len(rk), 3] = ts_rel(rk, base)
             nv_new[d] = len(rn)
             nv_known[d] = len(rk)
-        if record_metrics:
-            if lost:
-                m.lost_events.labels(
-                    stage="partition", plugin="engine"
-                ).inc(lost)
-            # Count only sides that actually cross the link (a skipped
-            # empty side never transfers) — this series is the wire-
-            # efficiency evidence and must not overcount.
-            m.transfer_bytes.inc(
-                (new_wire.nbytes if nv_new.any() else 0)
-                + (known_wire.nbytes if nv_known.any() else 0)
-            )
-            # Dictionary self-observability: the known/new ratio IS the
-            # wire savings; generation bumps reveal capacity cycling.
-            m.wire_rows.labels(kind="new").inc(int(nv_new.sum()))
-            m.wire_rows.labels(kind="known").inc(int(nv_known.sum()))
-            m.flow_dict_entries.set(fd_entries)
-            m.flow_dict_generation.set(fd_generation)
+        if record_metrics and lost:
+            m.lost_events.labels(
+                stage="partition", plugin="engine"
+            ).inc(lost)
         b_lo = np.uint32(base & np.uint64(0xFFFFFFFF))
         b_hi = np.uint32(base >> np.uint64(32))
         meta_new = np.empty((4 + D,), np.uint32)
@@ -754,6 +740,23 @@ class SketchEngine:
                     return
             self._device_consts()
             table = self._ensure_desc_table()
+            if record_metrics:
+                # Wire accounting AFTER the epoch check: a dropped
+                # pre-resync batch never ships, and these series are
+                # the wire-savings evidence — counted at build time
+                # they would overstate exactly in the failure windows
+                # an operator inspects. Only sides that actually cross
+                # the link count.
+                m.transfer_bytes.inc(
+                    (new_wire.nbytes if have_new else 0)
+                    + (known_wire.nbytes if have_known else 0)
+                )
+                m.wire_rows.labels(kind="new").inc(int(nv_new.sum()))
+                m.wire_rows.labels(kind="known").inc(
+                    int(nv_known.sum())
+                )
+                m.flow_dict_entries.set(fd_entries)
+                m.flow_dict_generation.set(fd_generation)
             t_x0 = time.perf_counter()
             # ONE batched device_put for everything this flush moves:
             # separate puts each pay a client round-trip on the tunnel
