@@ -1,0 +1,54 @@
+"""Shared full-agent boot harness for tests that run the real daemon
+(test_daemon_e2e, test_soak): seed pod identities, start the daemon on a
+background thread, wait for the HTTP server + engine, always tear down.
+
+Kept in one place so a change to daemon startup (port discovery,
+readiness signaling) is fixed once, not per test file."""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+from retina_tpu.common import RetinaEndpoint
+from retina_tpu.daemon import Daemon
+
+
+@contextmanager
+def running_agent(cfg, n_endpoints: int = 100, boot_timeout_s: float = 60.0):
+    """Yield ``(daemon, port)`` for a fully-booted agent.
+
+    Registers ``pod-1..pod-{n_endpoints-1}`` identities over the
+    synthetic source's 10.0.x.y pod range before start, mirroring what
+    the k8s watcher would feed a production agent."""
+    d = Daemon(cfg)
+    for i in range(1, n_endpoints):
+        d.cm.cache.update_endpoint(
+            RetinaEndpoint(
+                name=f"pod-{i}", namespace="default",
+                ips=(f"10.0.{i >> 8}.{i & 0xFF}",),
+            )
+        )
+    stop = threading.Event()
+    t = threading.Thread(target=d.start, args=(stop,), daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + boot_timeout_s
+        port = None
+        while time.monotonic() < deadline:
+            if d.cm.server is not None and d.cm.engine.started.is_set():
+                try:
+                    port = d.cm.server.port
+                    break
+                except AssertionError:  # server bound but port not set yet
+                    pass
+            time.sleep(0.1)
+        if port is None:
+            raise TimeoutError(
+                f"agent did not come up in {boot_timeout_s:.0f}s"
+            )
+        yield d, port
+    finally:
+        stop.set()
+        t.join(60.0)

@@ -6,25 +6,11 @@ This is the single-process analog of the reference's e2e scenario flow
 (test/e2e/scenarios/drop/scenario.go: generate traffic → scrape → assert
 series, via the Prometheus exposition parser)."""
 
-import threading
 import time
 import urllib.request
 
-import pytest
-
-from retina_tpu.common import RetinaEndpoint
+from agentboot import running_agent
 from retina_tpu.config import Config
-from retina_tpu.daemon import Daemon
-from retina_tpu.events.synthetic import POD_NET
-from retina_tpu.exporter import reset_for_tests as reset_exporter
-from retina_tpu.metrics import reset_for_tests as reset_metrics
-
-
-@pytest.fixture(autouse=True)
-def fresh():
-    reset_exporter()
-    reset_metrics()
-    yield
 
 
 def scrape(port: int) -> str:
@@ -53,31 +39,7 @@ def test_agent_end_to_end():
     cfg.metrics_interval_s = 0.2
     cfg.bypass_lookup_ip_of_interest = True
 
-    d = Daemon(cfg)
-    # Identity for the synthetic pod IP range (the k8s watcher analog).
-    for i in range(1, 100):
-        d.cm.cache.update_endpoint(
-            RetinaEndpoint(
-                name=f"pod-{i}", namespace="default",
-                ips=(f"10.0.{i >> 8}.{i & 0xFF}",),
-            )
-        )
-    stop = threading.Event()
-    t = threading.Thread(target=d.start, args=(stop,), daemon=True)
-    t.start()
-    try:
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline:
-            if d.cm.server is not None and d.cm.engine.started.is_set():
-                try:
-                    port = d.cm.server.port
-                    break
-                except AssertionError:
-                    pass
-            time.sleep(0.1)
-        else:
-            pytest.fail("agent did not come up")
-
+    with running_agent(cfg, boot_timeout_s=30.0) as (d, port):
         # readyz flips once everything is started
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
@@ -110,6 +72,3 @@ def test_agent_end_to_end():
         # Self-observability:
         assert "networkobservability_tpu_step_seconds" in text
         assert int(d.cm.engine._events_in) > 0
-    finally:
-        stop.set()
-        t.join(10.0)
