@@ -9,7 +9,6 @@ reads the production HTTP exposition surface, never Python internals.
 """
 
 import numpy as np
-import pytest
 
 from retina_tpu.e2e import (
     AssertNoCrashes,
@@ -38,20 +37,11 @@ from retina_tpu.events.schema import (
     DIR_INGRESS,
     ip_to_u32,
 )
-from retina_tpu.exporter import reset_for_tests as reset_exporter
-from retina_tpu.metrics import reset_for_tests as reset_metrics
 import retina_tpu.utils.metric_names as mn
 
 POD_A_IP = "10.0.0.10"
 POD_B_IP = "10.0.0.20"
 PODS = {"pod-a": POD_A_IP, "pod-b": POD_B_IP}
-
-
-@pytest.fixture(autouse=True)
-def fresh():
-    reset_exporter()
-    reset_metrics()
-    yield
 
 
 def base_records(n: int, src_ip: str, dst_ip: str, proto=PROTO_TCP,

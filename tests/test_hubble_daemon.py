@@ -5,21 +5,10 @@ streams enriched flows (the §3.5 call stack, end to end)."""
 import threading
 import time
 
-import pytest
-
 from retina_tpu.common import RetinaEndpoint
 from retina_tpu.config import Config
 from retina_tpu.daemon import Daemon
-from retina_tpu.exporter import reset_for_tests as reset_exporter
 from retina_tpu.hubble.server import HubbleClient
-from retina_tpu.metrics import reset_for_tests as reset_metrics
-
-
-@pytest.fixture(autouse=True)
-def fresh():
-    reset_exporter()
-    reset_metrics()
-    yield
 
 
 def test_hubble_daemon_flow_stream():

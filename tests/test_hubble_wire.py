@@ -29,17 +29,8 @@ from retina_tpu.events.schema import (
     EV_DROP,
     ip_to_u32,
 )
-from retina_tpu.exporter import reset_for_tests as reset_exporter
 from retina_tpu.hubble import FlowObserver, HubbleServer
 from retina_tpu.hubble import proto as pb
-from retina_tpu.metrics import reset_for_tests as reset_metrics
-
-
-@pytest.fixture(autouse=True)
-def fresh():
-    reset_exporter()
-    reset_metrics()
-    yield
 
 
 def records(n=10, src="10.1.0.1", dst="10.1.0.2", verdict=VERDICT_FORWARDED):

@@ -23,16 +23,8 @@ from retina_tpu.crd.types import (
     ValidationError,
 )
 from retina_tpu.events.schema import ip_to_u32
-from retina_tpu.exporter import get_exporter, reset_for_tests as reset_exporter
-from retina_tpu.metrics import reset_for_tests as reset_metrics
+from retina_tpu.exporter import get_exporter
 from retina_tpu.module.metrics_module import MetricsModule
-
-
-@pytest.fixture(autouse=True)
-def fresh():
-    reset_exporter()
-    reset_metrics()
-    yield
 
 
 # -------------------------------------------------------------- CRD types

@@ -16,19 +16,10 @@ from pathlib import Path
 import grpc
 import pytest
 
-from retina_tpu.exporter import reset_for_tests as reset_exporter
 from retina_tpu.hubble import proto as pb
 from retina_tpu.hubble.relay import HubbleRelay
-from retina_tpu.metrics import reset_for_tests as reset_metrics
 
 REPO = str(Path(__file__).resolve().parent.parent)
-
-
-@pytest.fixture(autouse=True)
-def fresh():
-    reset_exporter()
-    reset_metrics()
-    yield
 
 
 @pytest.fixture(scope="module")
