@@ -417,7 +417,7 @@ def cmd_observe(args: argparse.Namespace) -> int:
     client = HubbleClient(args.server)
     filt = FlowFilter(
         pod=args.pod, namespace=args.namespace, verdict=args.verdict,
-        protocol=args.protocol, port=args.port,
+        protocol=args.protocol, port=args.port, ip=args.ip,
     )
     try:
         for flow in client.get_flows(
@@ -713,6 +713,7 @@ def build_parser() -> argparse.ArgumentParser:
     ob.add_argument("--verdict")
     ob.add_argument("--protocol")
     ob.add_argument("--port", type=int)
+    ob.add_argument("--ip", help="match either endpoint IP")
     ob.add_argument("--json", action="store_true")
     ob.set_defaults(fn=cmd_observe)
 

@@ -93,8 +93,11 @@ def record_to_flow(
 
 
 class FlowFilter:
-    """Subset of Hubble's FlowFilter: pod/namespace/verdict/protocol/port
-    allow-matching (any-of within a field, all-of across fields)."""
+    """Subset of Hubble's FlowFilter: pod/namespace/verdict/protocol/
+    port/ip allow-matching (any-of within a field, all-of across
+    fields). ``ip`` is an EXACT match against either endpoint — unlike
+    the gRPC path (proto.py _one_filter_matches), whose source_ip/
+    destination_ip are independent prefix matches."""
 
     def __init__(
         self,
@@ -103,12 +106,14 @@ class FlowFilter:
         verdict: Optional[str] = None,
         protocol: Optional[str] = None,
         port: Optional[int] = None,
+        ip: Optional[str] = None,
     ):
         self.pod = pod
         self.namespace = namespace
         self.verdict = verdict
         self.protocol = protocol
         self.port = port
+        self.ip = ip
 
     def to_dict(self) -> dict[str, Any]:
         return {k: v for k, v in self.__dict__.items() if v is not None}
@@ -117,7 +122,7 @@ class FlowFilter:
     def from_dict(cls, d: dict[str, Any]) -> "FlowFilter":
         return cls(**{
             k: d.get(k) for k in
-            ("pod", "namespace", "verdict", "protocol", "port")
+            ("pod", "namespace", "verdict", "protocol", "port", "ip")
         })
 
     def matches(self, flow: dict[str, Any]) -> bool:
@@ -139,5 +144,9 @@ class FlowFilter:
             nss = {flow.get("source", {}).get("namespace"),
                    flow.get("destination", {}).get("namespace")}
             if self.namespace not in nss:
+                return False
+        if self.ip:
+            ips = flow.get("ip", {})
+            if self.ip not in (ips.get("source"), ips.get("destination")):
                 return False
         return True

@@ -94,6 +94,11 @@ def test_flow_filter():
     assert not FlowFilter(pod="other").matches(f)
     assert FlowFilter(verdict="FORWARDED", protocol="TCP", port=80).matches(f)
     assert not FlowFilter(port=443).matches(f)
+    assert FlowFilter(ip="10.0.0.1").matches(f)   # source endpoint
+    assert FlowFilter(ip="10.0.0.2").matches(f)   # destination endpoint
+    assert not FlowFilter(ip="10.9.9.9").matches(f)
+    # round-trips through the relay's dict wire encoding
+    assert FlowFilter.from_dict(FlowFilter(ip="10.0.0.1").to_dict()).matches(f)
 
 
 # ---------------------------------------------------------- monitoragent
