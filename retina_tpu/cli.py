@@ -418,6 +418,7 @@ def cmd_observe(args: argparse.Namespace) -> int:
     filt = FlowFilter(
         pod=args.pod, namespace=args.namespace, verdict=args.verdict,
         protocol=args.protocol, port=args.port, ip=args.ip,
+        event_type=args.type,
     )
     try:
         for flow in client.get_flows(
@@ -714,6 +715,9 @@ def build_parser() -> argparse.ArgumentParser:
     ob.add_argument("--protocol")
     ob.add_argument("--port", type=int)
     ob.add_argument("--ip", help="match either endpoint IP")
+    ob.add_argument("--type", choices=["flow", "drop", "dns_request",
+                                       "dns_response", "tcp_retransmit"],
+                    help="match the event type")
     ob.add_argument("--json", action="store_true")
     ob.set_defaults(fn=cmd_observe)
 

@@ -94,10 +94,13 @@ def record_to_flow(
 
 class FlowFilter:
     """Subset of Hubble's FlowFilter: pod/namespace/verdict/protocol/
-    port/ip allow-matching (any-of within a field, all-of across
-    fields). ``ip`` is an EXACT match against either endpoint — unlike
-    the gRPC path (proto.py _one_filter_matches), whose source_ip/
-    destination_ip are independent prefix matches."""
+    port/ip/event_type allow-matching (any-of within a field, all-of
+    across fields). ``ip`` is an EXACT match against either endpoint —
+    unlike the gRPC path (proto.py _one_filter_matches), whose
+    source_ip/destination_ip are independent prefix matches.
+    ``event_type`` matches the flow's event_type name (flow, drop,
+    dns_request, dns_response, tcp_retransmit — the `hubble observe
+    --type` analog)."""
 
     def __init__(
         self,
@@ -107,6 +110,7 @@ class FlowFilter:
         protocol: Optional[str] = None,
         port: Optional[int] = None,
         ip: Optional[str] = None,
+        event_type: Optional[str] = None,
     ):
         self.pod = pod
         self.namespace = namespace
@@ -114,6 +118,7 @@ class FlowFilter:
         self.protocol = protocol
         self.port = port
         self.ip = ip
+        self.event_type = event_type
 
     def to_dict(self) -> dict[str, Any]:
         return {k: v for k, v in self.__dict__.items() if v is not None}
@@ -122,7 +127,8 @@ class FlowFilter:
     def from_dict(cls, d: dict[str, Any]) -> "FlowFilter":
         return cls(**{
             k: d.get(k) for k in
-            ("pod", "namespace", "verdict", "protocol", "port", "ip")
+            ("pod", "namespace", "verdict", "protocol", "port", "ip",
+             "event_type")
         })
 
     def matches(self, flow: dict[str, Any]) -> bool:
@@ -149,4 +155,6 @@ class FlowFilter:
             ips = flow.get("ip", {})
             if self.ip not in (ips.get("source"), ips.get("destination")):
                 return False
+        if self.event_type and flow.get("event_type") != self.event_type:
+            return False
         return True

@@ -306,6 +306,19 @@ PEER_SERVICE_PB = "peer.Peer"
 
 _VERDICT_NUM = {"VERDICT_UNKNOWN": 0, "FORWARDED": 1, "DROPPED": 2}
 _DIR_NUM = {"TRAFFIC_DIRECTION_UNKNOWN": 0, "INGRESS": 1, "EGRESS": 2}
+# CiliumEventType.type numbering follows the monitor message types the
+# reference stamps (pkg/utils/flow_utils.go:102-104 trace, :292-295
+# drop with sub_type = drop reason, :193-195 access-log for L7/DNS;
+# numeric values per cilium pkg/monitor/api/types.go iota order, see
+# sources/cilium_monitor.py). tcp_retransmit has no Cilium analog: it
+# rides trace with sub_type 1 — Cilium's trace sub_types are
+# observation points, which this wire does not otherwise carry, so the
+# slot is free (documented divergence).
+_ET_DROP, _ET_TRACE, _ET_L7 = 1, 4, 5
+_ET_SUB_RETRANS = 1
+_EVENT_TYPE_NUM = {"flow": _ET_TRACE, "drop": _ET_DROP,
+                   "dns_request": _ET_L7, "dns_response": _ET_L7,
+                   "tcp_retransmit": _ET_TRACE}
 # DNS record-type names (upstream clients filter/group on these, not on
 # numeric qtypes).
 _QTYPE_NAMES = {1: "A", 2: "NS", 5: "CNAME", 6: "SOA", 12: "PTR",
@@ -367,6 +380,12 @@ def flow_dict_to_proto(f: dict[str, Any], node_name: str = "") -> Any:
                 msg.l7.dns.qtypes.append(_QTYPE_NAMES.get(int(qt), str(qt)))
             else:
                 msg.l7.dns.qtypes.append(str(qt))
+    et = f.get("event_type", "flow")
+    msg.event_type.type = _EVENT_TYPE_NUM.get(et, _ET_TRACE)
+    if et == "drop":
+        msg.event_type.sub_type = int(f.get("drop_reason") or 0)
+    elif et == "tcp_retransmit":
+        msg.event_type.sub_type = _ET_SUB_RETRANS
     msg.is_reply.value = bool(f.get("is_reply", False))
     msg.reply = bool(f.get("is_reply", False))
     return msg
@@ -424,6 +443,14 @@ def flow_proto_to_dict(msg: Any) -> dict[str, Any]:
         }
         f["event_type"] = ("dns_request" if msg.l7.type == 1
                            else "dns_response")
+    elif msg.event_type.type == _ET_DROP:
+        f["event_type"] = "drop"
+    elif (msg.event_type.type == _ET_TRACE
+          and msg.event_type.sub_type == _ET_SUB_RETRANS):
+        f["event_type"] = "tcp_retransmit"
+        f["tcp_retransmit"] = True
+    else:
+        f["event_type"] = "flow"
     return f
 
 

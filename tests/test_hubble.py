@@ -15,6 +15,7 @@ from retina_tpu.controllers.cache import Cache
 from retina_tpu.events.schema import (
     DIR_INGRESS,
     EV_DNS_REQ,
+    EV_DROP,
     EV_FORWARD,
     F,
     NUM_FIELDS,
@@ -97,8 +98,15 @@ def test_flow_filter():
     assert FlowFilter(ip="10.0.0.1").matches(f)   # source endpoint
     assert FlowFilter(ip="10.0.0.2").matches(f)   # destination endpoint
     assert not FlowFilter(ip="10.9.9.9").matches(f)
+    assert FlowFilter(event_type="flow").matches(f)
+    assert not FlowFilter(event_type="drop").matches(f)
+    fd = record_to_flow(mk_record(verdict=VERDICT_DROPPED, ev=EV_DROP))
+    assert FlowFilter(event_type="drop").matches(fd)
     # round-trips through the relay's dict wire encoding
     assert FlowFilter.from_dict(FlowFilter(ip="10.0.0.1").to_dict()).matches(f)
+    assert FlowFilter.from_dict(
+        FlowFilter(event_type="flow").to_dict()
+    ).matches(f)
 
 
 # ---------------------------------------------------------- monitoragent
