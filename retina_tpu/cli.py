@@ -410,19 +410,42 @@ def cmd_capture_delete(args: argparse.Namespace) -> int:
 
 
 # --------------------------------------------------------------- observe
+def _duration_ns(spec: str) -> int:
+    """'30s' / '5m' / '2h' / '1d' -> nanoseconds (hubble observe
+    --since duration style)."""
+    units = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+    if not spec or spec[-1] not in units or not spec[:-1].isdigit():
+        raise SystemExit(
+            f"bad duration {spec!r}: expected e.g. 30s, 5m, 2h, 1d"
+        )
+    return int(spec[:-1]) * units[spec[-1]] * 1_000_000_000
+
+
 def cmd_observe(args: argparse.Namespace) -> int:
     from retina_tpu.hubble.flow import FlowFilter
     from retina_tpu.hubble.server import HubbleClient
 
     client = HubbleClient(args.server)
+    now_ns = time.time_ns()
     filt = FlowFilter(
         pod=args.pod, namespace=args.namespace, verdict=args.verdict,
         protocol=args.protocol, port=args.port, ip=args.ip,
         event_type=args.type,
+        # Clamped at the epoch: a span longer than wall-clock time means
+        # "everything" (and negative ints overflow the msgpack wire).
+        since_ns=max(0, now_ns - _duration_ns(args.since))
+        if args.since else None,
+        until_ns=max(0, now_ns - _duration_ns(args.until))
+        if args.until else None,
     )
+    # A time window names its own span: --since without an explicit
+    # --last means "everything in the window", not the default last-20
+    # (the msgpack surface sizes the scan window from `last` BEFORE
+    # filtering, so a nonzero default would silently truncate).
+    last = args.last if args.last is not None else (0 if args.since else 20)
     try:
         for flow in client.get_flows(
-            filter=filt, last=args.last, follow=args.follow
+            filter=filt, last=last, follow=args.follow
         ):
             if args.json:
                 print(json.dumps(flow))
@@ -708,7 +731,9 @@ def build_parser() -> argparse.ArgumentParser:
     ob = sub.add_parser("observe", help="stream flows from the relay")
     ob.add_argument("--server", default="127.0.0.1:4244")
     ob.add_argument("--follow", action="store_true")
-    ob.add_argument("--last", type=int, default=20)
+    ob.add_argument("--last", type=int, default=None,
+                    help="N most recent (default 20; a --since window "
+                         "defaults to everything in the window)")
     ob.add_argument("--pod")
     ob.add_argument("--namespace")
     ob.add_argument("--verdict")
@@ -718,6 +743,9 @@ def build_parser() -> argparse.ArgumentParser:
     ob.add_argument("--type", choices=["flow", "drop", "dns_request",
                                        "dns_response", "tcp_retransmit"],
                     help="match the event type")
+    ob.add_argument("--since", help="only flows newer than this long "
+                                    "ago (30s, 5m, 2h, 1d)")
+    ob.add_argument("--until", help="only flows older than this long ago")
     ob.add_argument("--json", action="store_true")
     ob.set_defaults(fn=cmd_observe)
 

@@ -100,7 +100,9 @@ class FlowFilter:
     source_ip/destination_ip are independent prefix matches.
     ``event_type`` matches the flow's event_type name (flow, drop,
     dns_request, dns_response, tcp_retransmit — the `hubble observe
-    --type` analog)."""
+    --type` analog). ``since_ns``/``until_ns`` bound the flow's
+    timestamp (the GetFlowsRequest since/until analog; unstamped flows
+    carry time_ns 0 and fall outside any since bound)."""
 
     def __init__(
         self,
@@ -111,6 +113,8 @@ class FlowFilter:
         port: Optional[int] = None,
         ip: Optional[str] = None,
         event_type: Optional[str] = None,
+        since_ns: Optional[int] = None,
+        until_ns: Optional[int] = None,
     ):
         self.pod = pod
         self.namespace = namespace
@@ -119,6 +123,8 @@ class FlowFilter:
         self.port = port
         self.ip = ip
         self.event_type = event_type
+        self.since_ns = since_ns
+        self.until_ns = until_ns
 
     def to_dict(self) -> dict[str, Any]:
         return {k: v for k, v in self.__dict__.items() if v is not None}
@@ -128,7 +134,7 @@ class FlowFilter:
         return cls(**{
             k: d.get(k) for k in
             ("pod", "namespace", "verdict", "protocol", "port", "ip",
-             "event_type")
+             "event_type", "since_ns", "until_ns")
         })
 
     def matches(self, flow: dict[str, Any]) -> bool:
@@ -157,4 +163,10 @@ class FlowFilter:
                 return False
         if self.event_type and flow.get("event_type") != self.event_type:
             return False
+        if self.since_ns is not None or self.until_ns is not None:
+            t = int(flow.get("time_ns", 0))
+            if self.since_ns is not None and t < self.since_ns:
+                return False
+            if self.until_ns is not None and t > self.until_ns:
+                return False
         return True

@@ -235,6 +235,17 @@ class HubbleServer:
         whitelist = list(request.whitelist)
         blacklist = list(request.blacklist)
         last = int(request.number)
+        # GetFlowsRequest since/until (flows carry time_ns; an unset
+        # Timestamp is all-zero, meaning unbounded).
+        since_ns = (request.since.seconds * 1_000_000_000
+                    + request.since.nanos) if request.HasField("since") else 0
+        until_ns = (request.until.seconds * 1_000_000_000
+                    + request.until.nanos) if request.HasField("until") else 0
+
+        def in_window(flow) -> bool:
+            t = int(flow.get("time_ns", 0))
+            return not ((since_ns and t < since_ns)
+                        or (until_ns and t > until_ns))
 
         def passes(msg) -> bool:
             if not pb.proto_filter_matches(whitelist, msg):
@@ -261,6 +272,9 @@ class HubbleServer:
         buffered, cursor = self.observer.snapshot_flows()
         matching = []
         for flow in buffered:
+            # Time bounds come first: they need no proto conversion.
+            if not in_window(flow):
+                continue
             msg = pb.flow_dict_to_proto(flow, node_name=self.node_name)
             if passes(msg):
                 matching.append((flow, msg))
@@ -281,6 +295,13 @@ class HubbleServer:
                 resp.lost_events.source = 3  # HUBBLE_RING_BUFFER
                 resp.lost_events.num_events_lost = int(payload)
                 yield resp
+                continue
+            if not in_window(payload):
+                if until_ns and int(payload.get("time_ns", 0)) > until_ns:
+                    # Timestamps advance batch over batch: nothing after
+                    # the until bound can ever match — end the stream
+                    # instead of pinning a server worker forever.
+                    return
                 continue
             msg = pb.flow_dict_to_proto(payload, node_name=self.node_name)
             if passes(msg):

@@ -102,10 +102,19 @@ def test_flow_filter():
     assert not FlowFilter(event_type="drop").matches(f)
     fd = record_to_flow(mk_record(verdict=VERDICT_DROPPED, ev=EV_DROP))
     assert FlowFilter(event_type="drop").matches(fd)
+    # time bounds: mk_record stamps TS_LO=12345 -> time_ns 12345
+    assert FlowFilter(since_ns=12345).matches(f)
+    assert not FlowFilter(since_ns=12346).matches(f)
+    assert FlowFilter(until_ns=12345).matches(f)
+    assert not FlowFilter(until_ns=12344).matches(f)
+    assert FlowFilter(since_ns=12000, until_ns=13000).matches(f)
     # round-trips through the relay's dict wire encoding
     assert FlowFilter.from_dict(FlowFilter(ip="10.0.0.1").to_dict()).matches(f)
     assert FlowFilter.from_dict(
         FlowFilter(event_type="flow").to_dict()
+    ).matches(f)
+    assert not FlowFilter.from_dict(
+        FlowFilter(since_ns=12346).to_dict()
     ).matches(f)
 
 

@@ -363,3 +363,66 @@ def test_event_type_survives_proto_roundtrip():
         dict(base, event_type="tcp_retransmit")
     ))
     assert retr["tcp_retransmit"] is True
+
+
+def test_get_flows_since_until_time_bounds():
+    """GetFlowsRequest.since/until bound the returned window by the
+    flow timestamp on the protobuf surface (observer.proto fields 7/8)."""
+    obs, srv = serve()
+    try:
+        early = records(3)
+        early[:, F.TS_LO] = 1000
+        late = records(2)
+        late[:, F.TS_LO] = 5000
+        obs.consume(early)
+        obs.consume(late)
+        chan = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        get_flows = chan.unary_stream(
+            "/observer.Observer/GetFlows",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.GetFlowsResponse.FromString,
+        )
+        req = pb.GetFlowsRequest()
+        req.since.nanos = 2000
+        got = list(get_flows(req, timeout=10))
+        assert len(got) == 2  # only the late flows
+        req2 = pb.GetFlowsRequest()
+        req2.until.nanos = 2000
+        got2 = list(get_flows(req2, timeout=10))
+        assert len(got2) == 3  # only the early flows
+        req3 = pb.GetFlowsRequest()  # both unset: everything
+        assert len(list(get_flows(req3, timeout=10))) == 5
+        chan.close()
+    finally:
+        srv.stop()
+
+
+def test_follow_with_past_until_terminates():
+    """follow=true with an `until` already in the past must end the
+    stream once a newer flow proves nothing can match again — not pin a
+    server worker forever."""
+    obs, srv = serve()
+    try:
+        early = records(2)
+        early[:, F.TS_LO] = 1000
+        obs.consume(early)
+        chan = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        get_flows = chan.unary_stream(
+            "/observer.Observer/GetFlows",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.GetFlowsResponse.FromString,
+        )
+        req = pb.GetFlowsRequest()
+        req.follow = True
+        req.until.nanos = 2000
+        stream = get_flows(req, timeout=15)
+        got = [next(stream), next(stream)]  # the two early flows
+        assert all(g.flow.IP.source == "10.1.0.1" for g in got)
+        late = records(1)
+        late[:, F.TS_LO] = 9000  # beyond until -> server ends stream
+        obs.consume(late)
+        with pytest.raises(StopIteration):
+            next(stream)
+        chan.close()
+    finally:
+        srv.stop()
