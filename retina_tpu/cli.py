@@ -10,6 +10,7 @@ entry point here, subcommand per role:
   operator  run the operator over a watch directory of CRD YAMLs
   capture   create/list/download/delete packet captures (operator-less)
   observe   stream flows from the Hubble relay (hubble observe analog)
+  status    flow-server occupancy + peers (hubble status analog)
   top       heavy-hitter tables from a running agent
   config    print the effective layered configuration
   trace     sampled flow traces from the agent (module/traces; the
@@ -467,6 +468,31 @@ def cmd_observe(args: argparse.Namespace) -> int:
     return 0
 
 
+# --------------------------------------------------------------- status
+def cmd_status(args: argparse.Namespace) -> int:
+    """`hubble status` analog: flow-buffer occupancy + peer set of a
+    node agent or cluster relay."""
+    from retina_tpu.hubble.server import HubbleClient
+
+    client = HubbleClient(args.server)
+    try:
+        st = client.server_status()
+        peers = client.list_peers()
+    finally:
+        client.close()
+    if args.json:
+        print(json.dumps({"status": st, "peers": peers}))
+        return 0
+    cap = int(st.get("max_flows", 0)) or 1
+    print(f"Current/Max Flows: {st.get('num_flows', 0)}/{cap} "
+          f"({100.0 * int(st.get('num_flows', 0)) / cap:.2f}%)")
+    print(f"Flows seen total: {st.get('seen_flows', 0)}")
+    print(f"Uptime: {int(st.get('uptime_ns', 0)) / 1e9:.0f}s")
+    for p in peers:
+        print(f"peer: {p.get('name', '?')} at {p.get('address', '?')}")
+    return 0
+
+
 # ------------------------------------------------------------------ top
 def cmd_top(args: argparse.Namespace) -> int:
     url = f"http://{args.server}/debug/vars"
@@ -748,6 +774,11 @@ def build_parser() -> argparse.ArgumentParser:
     ob.add_argument("--until", help="only flows older than this long ago")
     ob.add_argument("--json", action="store_true")
     ob.set_defaults(fn=cmd_observe)
+
+    st = sub.add_parser("status", help="flow-server status and peers")
+    st.add_argument("--server", default="127.0.0.1:4244")
+    st.add_argument("--json", action="store_true")
+    st.set_defaults(fn=cmd_status)
 
     tp = sub.add_parser("top", help="heavy-hitter tables")
     tp.add_argument("what", choices=["flows", "services", "dns"])

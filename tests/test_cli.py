@@ -120,3 +120,35 @@ def test_capture_filter_flag(tmp_path, capsys, replay_capture):
         "--duration", "1", "--filter", "host 10.0.0.5",
     ])
     assert rc == 0
+
+
+def test_status_verb(capsys):
+    """status (hubble status analog) against a live flow server, text
+    and JSON forms."""
+    import json
+
+    import numpy as np
+
+    from retina_tpu.events.schema import F, NUM_FIELDS
+    from retina_tpu.hubble import FlowObserver, HubbleServer
+
+    obs = FlowObserver(capacity=1 << 8)
+    rec = np.zeros((5, NUM_FIELDS), np.uint32)
+    rec[:, F.SRC_IP] = 1
+    rec[:, F.PACKETS] = 1
+    obs.consume(rec)
+    srv = HubbleServer(obs, addr="127.0.0.1:0")
+    srv.start()
+    try:
+        assert main(["status", "--server", f"127.0.0.1:{srv.port}"]) == 0
+        out = capsys.readouterr().out
+        assert "Current/Max Flows: 5/256" in out
+        assert "Flows seen total: 5" in out
+        assert main(
+            ["status", "--server", f"127.0.0.1:{srv.port}", "--json"]
+        ) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["status"]["seen_flows"] == 5
+        assert doc["peers"] == []
+    finally:
+        srv.stop()
