@@ -62,7 +62,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         src_mtime = max(
             os.path.getmtime(os.path.join(_dir, f))
             for f in ("decoder.cpp", "ring.cpp", "combine.cpp",
-                      "afpacket.cpp", "flowdict.cpp")
+                      "afpacket.cpp", "flowdict.cpp", "pack.cpp")
         )
         if (not os.path.exists(_so_path)
                 or os.path.getmtime(_so_path) < src_mtime):
@@ -101,6 +101,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32),
             ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint32),
             ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.rt_ts_base.restype = ctypes.c_uint64
+        lib.rt_ts_base.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+        ]
+        lib.rt_pack.restype = None
+        lib.rt_pack.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint32),
         ]
         lib.rt_afp_open.restype = ctypes.c_void_p
         lib.rt_afp_open.argtypes = [
@@ -193,6 +202,33 @@ def combine_native(records: np.ndarray) -> Optional[np.ndarray]:
     if g == n:
         return records
     return out[:g]
+
+
+def pack_native(
+    records: np.ndarray, base: Optional[int] = None
+) -> Optional[tuple]:
+    """C++ wire packer (pack.cpp): (n, 16) u32 -> ((n, 12) u32, base).
+    Returns None when the native library is unavailable or the input is
+    not a 2-D schema array (callers fall back to the numpy path).
+    Semantics match parallel.wire.pack_records — cross-checked by
+    tests/test_native.py."""
+    lib = get_lib()
+    if (lib is None or records.ndim != 2 or records.dtype != np.uint32
+            or records.shape[1] != NUM_FIELDS):
+        return None
+    if not records.flags.c_contiguous:
+        records = np.ascontiguousarray(records)
+    n = len(records)
+    rows = records.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+    if base is None:
+        base = int(lib.rt_ts_base(rows, n)) if n else 0
+    out = np.empty((n, 12), np.uint32)
+    if n:
+        lib.rt_pack(
+            rows, n, ctypes.c_uint64(base),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+    return out, base
 
 
 class NativeFlowDict:

@@ -274,3 +274,41 @@ def test_afpacket_ring_dns_sidecar_names():
         assert (dnsr[:, F.DNS_QHASH] == np.uint32(h)).any()
     finally:
         ring.close()
+
+
+def test_pack_native_matches_numpy_reference():
+    """pack.cpp must be bit-identical to the numpy pack_records math on
+    random batches, zero timestamps, saturating narrow lanes, and the
+    ts < base unsigned wrap."""
+    from retina_tpu.events.schema import F
+    from retina_tpu.native import pack_native
+    from retina_tpu.parallel import wire
+
+    rng = np.random.default_rng(7)
+    rec = rng.integers(
+        0, 2 ** 32, size=(4096, NUM_FIELDS), dtype=np.uint32
+    )
+    rec[:128, F.TS_LO] = 0
+    rec[:128, F.TS_HI] = 0  # unstamped rows keep TS_REL 0
+    rec[128:192, F.VERDICT] = 9  # past every saturation bound
+    rec[128:192, F.DROP_REASON] = 400
+    rec[128:192, F.EVENT_TYPE] = 77
+    rec[128:192, F.IFINDEX] = 1 << 20
+    got = pack_native(rec)
+    if got is None:
+        pytest.skip("native library unavailable")
+    out_nat, base_nat = got
+    # The numpy path is reached via a 3-D view (native only takes 2-D).
+    out_ref, lo, hi = wire.pack_records(rec[None])
+    assert base_nat == (int(hi) << 32) | int(lo)
+    np.testing.assert_array_equal(out_nat, out_ref[0])
+
+    # Explicit base larger than some timestamps: u64 wrap saturates.
+    base = int(wire.batch_ts_base(rec)) + (1 << 40)
+    out_nat2, _ = pack_native(rec, base)
+    out_ref2, _, _ = wire.pack_records(rec[None], base=np.uint64(base))
+    np.testing.assert_array_equal(out_nat2, out_ref2[0])
+
+    # Empty batch.
+    out_e, base_e = pack_native(rec[:0])
+    assert out_e.shape == (0, 12) and base_e == 0
