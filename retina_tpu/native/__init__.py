@@ -86,6 +86,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_uint32),
         ]
+        lib.rt_combine_hint.restype = ctypes.c_long
+        lib.rt_combine_hint.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+        ]
         lib.rt_flowdict_new.restype = ctypes.c_void_p
         lib.rt_flowdict_new.argtypes = [ctypes.c_uint32]
         lib.rt_flowdict_free.restype = None
@@ -177,12 +182,22 @@ def decode_pcap_native(data: bytes, obs_point: int = 2) -> Optional[tuple]:
         return out[:n], int(total.value)
 
 
+# Distinct-group count of the previous combine: flush-over-flush flow
+# diversity is stable, so sizing the next probe table from it keeps the
+# table cache-resident (combine.cpp rt_combine_hint grows it when the
+# hint undershoots — identical results either way). Plain int store:
+# only the engine feed thread writes it, and a stale read only costs a
+# suboptimal table size.
+_combine_hint_groups = 0
+
+
 def combine_native(records: np.ndarray) -> Optional[np.ndarray]:
     """C++ descriptor-RLE combine (combine.cpp). Returns the combined
     (G, 16) array, or None when the library is unavailable. Semantics
     match parallel.combine.combine_records_numpy; the ctypes call
     releases the GIL, so combining overlaps device transfers running on
     another thread."""
+    global _combine_hint_groups
     lib = get_lib()
     if lib is None:
         return None
@@ -192,13 +207,17 @@ def combine_native(records: np.ndarray) -> Optional[np.ndarray]:
     if not records.flags.c_contiguous:
         records = np.ascontiguousarray(records)
     out = np.empty_like(records)
-    g = lib.rt_combine(
+    # Target load factor <= 0.25 at the remembered group count so the
+    # common case never pays the grow-and-rehash.
+    g = lib.rt_combine_hint(
         records.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
         n,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        4 * _combine_hint_groups,
     )
     if g < 0:
         return None
+    _combine_hint_groups = int(g)
     if g == n:
         return records
     return out[:g]

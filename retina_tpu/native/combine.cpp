@@ -51,15 +51,34 @@ extern "C" {
 // rows: (n, 16) u32 row-major. out: caller buffer with room for n rows.
 // Returns the number of combined rows written to out, or -1 on alloc
 // failure. out may alias nothing (distinct buffer required).
-long rt_combine(const uint32_t* rows, size_t n, uint32_t* out) {
+//
+// hint_slots (rt_combine_hint): expected table size from the caller's
+// previous quantum — distinct-flow counts are stable flush over flush,
+// and a table sized to the distinct count stays cache-resident where
+// the worst-case 2n sizing (16 MB at production quanta) probes cold
+// RAM. 0 means no hint (worst-case sizing, the old behavior). When a
+// hint undershoots, the table doubles and re-inserts the g combined
+// rows seen so far (cheap: g << n), so results are identical for any
+// hint.
+long rt_combine_hint(const uint32_t* rows, size_t n, uint32_t* out,
+                     size_t hint_slots) {
   if (n == 0) return 0;
-  // Table of output indices, power-of-two >= 2n slots; empty = UINT32_MAX.
-  size_t slots = 16;
-  while (slots < 2 * n) slots <<= 1;
+  // Table of output indices, power-of-two >= 2n slots (or the hint);
+  // empty = UINT32_MAX.
+  size_t worst = 16;
+  while (worst < 2 * n) worst <<= 1;
+  size_t slots = worst;
+  if (hint_slots) {
+    slots = 1024;
+    // The worst-case bound also guards the shift: an absurd hint from
+    // a direct ABI caller must clamp, not overflow slots to 0 and spin.
+    while (slots < hint_slots && slots < worst) slots <<= 1;
+    if (slots > worst) slots = worst;
+  }
   uint32_t* table = (uint32_t*)malloc(slots * sizeof(uint32_t));
   if (!table) return -1;
   memset(table, 0xFF, slots * sizeof(uint32_t));
-  const size_t mask = slots - 1;
+  size_t mask = slots - 1;
   size_t g = 0;
   // The table exceeds cache at production quanta (2x rows slots);
   // hashing ahead and prefetching the slot hides most of the miss
@@ -77,6 +96,31 @@ long rt_combine(const uint32_t* rows, size_t n, uint32_t* out) {
       size_t h = hash_row(rows + (i + kAhead) * NUM_FIELDS);
       next_hashes[(i + kAhead) % kAhead] = h;
       __builtin_prefetch(&table[h & mask]);
+    }
+    if (2 * g >= slots && slots < worst) {
+      // Hint undershot: double and re-insert the combined rows so far
+      // (their keys are distinct by construction — no compare needed).
+      size_t nslots = slots << 1;
+      uint32_t* ntable = (uint32_t*)malloc(nslots * sizeof(uint32_t));
+      if (!ntable) {
+        free(table);
+        return -1;
+      }
+      memset(ntable, 0xFF, nslots * sizeof(uint32_t));
+      size_t nmask = nslots - 1;
+      for (size_t j = 0; j < g; j++) {
+        size_t s = hash_row(out + j * NUM_FIELDS) & nmask;
+        while (ntable[s] != 0xFFFFFFFFu) s = (s + 1) & nmask;
+        ntable[s] = (uint32_t)j;
+      }
+      free(table);
+      table = ntable;
+      slots = nslots;
+      mask = nmask;
+      // next_hashes[i % kAhead] was already overwritten with row
+      // i+kAhead's hash by the pipeline update above — rehash the
+      // current row instead of reading the stale pipeline slot.
+      slot = hash_row(row) & mask;
     }
     for (;;) {
       uint32_t gid = table[slot];
@@ -103,6 +147,10 @@ long rt_combine(const uint32_t* rows, size_t n, uint32_t* out) {
   }
   free(table);
   return (long)g;
+}
+
+long rt_combine(const uint32_t* rows, size_t n, uint32_t* out) {
+  return rt_combine_hint(rows, n, out, 0);
 }
 
 }  // extern "C"

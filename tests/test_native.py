@@ -312,3 +312,37 @@ def test_pack_native_matches_numpy_reference():
     # Empty batch.
     out_e, base_e = pack_native(rec[:0])
     assert out_e.shape == (0, 12) and base_e == 0
+
+
+def test_combine_hint_grow_path_identical():
+    """rt_combine_hint must return identical groups for any hint —
+    including one that undershoots so far the table doubles repeatedly
+    mid-pass (combine.cpp grow-and-rehash)."""
+    import ctypes
+
+    from retina_tpu.native import get_lib
+
+    lib = get_lib()
+    if lib is None:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(11)
+    n = 40_000
+    rec = rng.integers(0, 2 ** 32, size=(n, NUM_FIELDS), dtype=np.uint32)
+    rec[:, 7] = 1  # PACKETS
+    # Half the rows repeat earlier descriptors so accumulation happens.
+    rec[n // 2:] = rec[: n // 2]
+    rows = np.ascontiguousarray(rec)
+    p = rows.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+    outs = []
+    for hint in (0, 1, 1024, 1 << 20):
+        out = np.empty_like(rows)
+        g = lib.rt_combine_hint(
+            p, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            hint,
+        )
+        assert g == n // 2, (hint, g)
+        # Row order is first-appearance for every hint -> bit-identical.
+        outs.append(out[:g].copy())
+    for o in outs[1:]:
+        np.testing.assert_array_equal(outs[0], o)
+    assert (outs[0][:, 7] == 2).all()  # every group accumulated 2 packets
