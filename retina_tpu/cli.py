@@ -456,8 +456,14 @@ def cmd_observe(args: argparse.Namespace) -> int:
                 dst = flow.get("destination", {}).get("pod_name") or \
                     flow["ip"]["destination"]
                 l4 = flow["l4"]
+                ts = int(flow.get("time_ns", 0))
+                tstr = (
+                    time.strftime("%b %d %H:%M:%S",
+                                  time.localtime(ts // 1_000_000_000))
+                    + f".{ts % 1_000_000_000 // 1_000_000:03d}"
+                ) if ts else "-"
                 print(
-                    f"{src}:{l4['source_port']} -> {dst}:"
+                    f"{tstr} {src}:{l4['source_port']} -> {dst}:"
                     f"{l4['destination_port']} {l4['protocol']} "
                     f"{flow['verdict']} {flow['event_type']}"
                 )

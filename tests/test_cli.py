@@ -152,3 +152,35 @@ def test_status_verb(capsys):
         assert doc["peers"] == []
     finally:
         srv.stop()
+
+
+def test_observe_text_output_has_timestamp(capsys):
+    """observe's text form leads with the flow timestamp (hubble
+    observe's line shape), falling back to '-' for unstamped flows."""
+    import numpy as np
+
+    from retina_tpu.events.schema import F, NUM_FIELDS
+    from retina_tpu.hubble import FlowObserver, HubbleServer
+
+    obs = FlowObserver(capacity=1 << 8)
+    rec = np.zeros((2, NUM_FIELDS), np.uint32)
+    rec[:, F.SRC_IP] = 0x0A000001
+    rec[:, F.DST_IP] = 0x0A000002
+    rec[:, F.PORTS] = (1000 << 16) | 80
+    rec[0, F.TS_LO] = 1_700_000_000 * 10 ** 9 % (1 << 32)
+    rec[0, F.TS_HI] = 1_700_000_000 * 10 ** 9 >> 32
+    # rec[1] stays unstamped
+    obs.consume(rec)
+    srv = HubbleServer(obs, addr="127.0.0.1:0")
+    srv.start()
+    try:
+        assert main(["observe", "--server", f"127.0.0.1:{srv.port}"]) == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 2
+        stamped = [l for l in lines if not l.startswith("- ")]
+        unstamped = [l for l in lines if l.startswith("- ")]
+        assert len(stamped) == 1 and len(unstamped) == 1
+        # Nov 2023 epoch renders as a month-day time with millis.
+        assert "Nov" in stamped[0] and "10.0.0.1:1000 -> 10.0.0.2:80" in stamped[0]
+    finally:
+        srv.stop()
