@@ -128,6 +128,11 @@ class Config:
     # map pre-aggregation analog, parallel/combine.py). Lossless; off only
     # for debugging raw row flow.
     host_combine: bool = True
+    # Worker threads for the native combiner (combine.cpp
+    # rt_combine_mt): per-thread partial combines + one small merge.
+    # 0 = auto (RETINA_COMBINE_THREADS env, else cores-1 capped at 4 —
+    # 1 on single-core hosts, i.e. the single-threaded pass).
+    host_combine_threads: int = 0
     # Depth of the in-flight transfer queue between the batcher thread and
     # the device dispatch thread (engine.py), and the bound on concurrent
     # fire-and-forget device submissions (transfers queued back-to-back on

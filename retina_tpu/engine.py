@@ -132,6 +132,12 @@ class SketchEngine:
         # per-flush fixed costs).
         self._busy_lock = threading.Lock()
         self._inflight_busy = 0
+        # Combiner thread count (native rt_combine_mt; 0 keeps the
+        # cores-based default — 1 thread on single-core hosts).
+        if cfg.host_combine_threads > 0:
+            from retina_tpu.native import set_combine_threads
+
+            set_combine_threads(cfg.host_combine_threads)
         # v2 wire: flow-descriptor dictionary (parallel/flowdict.py).
         # Host side assigns stable device-table slots; the device table
         # itself is created lazily ON device (zeros jit — a host-side

@@ -91,6 +91,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
         ]
+        lib.rt_combine_mt.restype = ctypes.c_long
+        lib.rt_combine_mt.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            ctypes.c_uint,
+        ]
         lib.rt_flowdict_new.restype = ctypes.c_void_p
         lib.rt_flowdict_new.argtypes = [ctypes.c_uint32]
         lib.rt_flowdict_free.restype = None
@@ -191,6 +197,28 @@ def decode_pcap_native(data: bytes, obs_point: int = 2) -> Optional[tuple]:
 _combine_hint_groups = 0
 
 
+def _default_combine_threads() -> int:
+    """RETINA_COMBINE_THREADS, else cores-1 capped at 4 (the combiner
+    shares the host with the agent's feed/proxy/server threads). On the
+    1-core bench host this resolves to 1 — the single-threaded pass."""
+    env = os.environ.get("RETINA_COMBINE_THREADS", "")
+    if env.isdigit():
+        return max(1, int(env))
+    return max(1, min(4, (os.cpu_count() or 1) - 1))
+
+
+_combine_threads = _default_combine_threads()
+
+
+def set_combine_threads(n: int) -> None:
+    """Engine/config hook (host_combine_threads). PROCESS-WIDE: the
+    combiner is shared library state, so with several engines in one
+    process the last setter wins (the daemon runs one engine). 0
+    restores the auto default."""
+    global _combine_threads
+    _combine_threads = int(n) if n > 0 else _default_combine_threads()
+
+
 def combine_native(records: np.ndarray) -> Optional[np.ndarray]:
     """C++ descriptor-RLE combine (combine.cpp). Returns the combined
     (G, 16) array, or None when the library is unavailable. Semantics
@@ -209,11 +237,12 @@ def combine_native(records: np.ndarray) -> Optional[np.ndarray]:
     out = np.empty_like(records)
     # Target load factor <= 0.25 at the remembered group count so the
     # common case never pays the grow-and-rehash.
-    g = lib.rt_combine_hint(
+    g = lib.rt_combine_mt(
         records.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
         n,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
         4 * _combine_hint_groups,
+        _combine_threads,
     )
     if g < 0:
         return None

@@ -14,6 +14,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <thread>
 
 namespace {
 
@@ -147,6 +148,87 @@ long rt_combine_hint(const uint32_t* rows, size_t n, uint32_t* out,
   }
   free(table);
   return (long)g;
+}
+
+// Multi-threaded combine for multi-core hosts: T contiguous chunks
+// combined independently (each with its own table), then one
+// sequential merge pass over the concatenated partials (G_total rows,
+// ~n/ratio — cheap). Row order differs from the single-thread pass
+// (chunk-major first-appearance); consumers treat order as arbitrary
+// (see header). nthreads <= 1, tiny inputs, or any allocation failure
+// fall back to the single-threaded pass — results are equivalent
+// either way (cross-checked as key -> value maps by the test suite).
+long rt_combine_mt(const uint32_t* rows, size_t n, uint32_t* out,
+                   size_t hint_slots, unsigned nthreads) {
+  constexpr size_t kMinPerThread = 1 << 15;
+  if (nthreads > 16) nthreads = 16;
+  if (nthreads <= 1 || n < 2 * kMinPerThread)
+    return rt_combine_hint(rows, n, out, hint_slots);
+  if ((size_t)nthreads > n / kMinPerThread)
+    nthreads = (unsigned)(n / kMinPerThread);
+
+  uint32_t* scratch =
+      (uint32_t*)malloc(n * NUM_FIELDS * sizeof(uint32_t));
+  if (!scratch) return rt_combine_hint(rows, n, out, hint_slots);
+  long* counts = (long*)malloc(nthreads * sizeof(long));
+  if (!counts) {
+    free(scratch);
+    return rt_combine_hint(rows, n, out, hint_slots);
+  }
+
+  size_t chunk = n / nthreads;
+  size_t per_hint = hint_slots ? hint_slots / nthreads : 0;
+  // Spawn-per-call is fine at these sizes: threading only engages at
+  // >= 64k rows, where create+join (tens of us) is <0.1% of the pass.
+  // std::thread construction can throw (EAGAIN under pid-limit
+  // pressure) — that must become the single-threaded fallback, never
+  // an exception across the extern "C" boundary (std::terminate).
+  std::thread workers[16];
+  unsigned spawned = 0;
+  try {
+    for (unsigned t = 0; t < nthreads; t++) {
+      size_t lo = t * chunk;
+      size_t hi = (t == nthreads - 1) ? n : lo + chunk;
+      workers[t] = std::thread([=]() {
+        counts[t] = rt_combine_hint(rows + lo * NUM_FIELDS, hi - lo,
+                                    scratch + lo * NUM_FIELDS, per_hint);
+      });
+      spawned++;
+    }
+  } catch (...) {
+    for (unsigned t = 0; t < spawned; t++) workers[t].join();
+    free(counts);
+    free(scratch);
+    return rt_combine_hint(rows, n, out, hint_slots);
+  }
+  for (unsigned t = 0; t < nthreads; t++) workers[t].join();
+
+  bool failed = false;
+  size_t total = 0;
+  for (unsigned t = 0; t < nthreads; t++) {
+    if (counts[t] < 0) failed = true;
+    else total += (size_t)counts[t];
+  }
+  long g = -1;
+  if (!failed) {
+    // Compact the partials to one contiguous run, then merge. The
+    // compaction reuses scratch in place (partials are in ascending
+    // offsets, so memmove is safe front to back).
+    size_t off = 0;
+    for (unsigned t = 0; t < nthreads; t++) {
+      size_t lo = t * chunk;
+      size_t cnt = (size_t)counts[t];
+      if (off != lo && cnt)
+        memmove(scratch + off * NUM_FIELDS, scratch + lo * NUM_FIELDS,
+                cnt * NUM_FIELDS * sizeof(uint32_t));
+      off += cnt;
+    }
+    g = rt_combine_hint(scratch, total, out, hint_slots);
+  }
+  free(counts);
+  free(scratch);
+  if (g < 0) return rt_combine_hint(rows, n, out, hint_slots);
+  return g;
 }
 
 long rt_combine(const uint32_t* rows, size_t n, uint32_t* out) {
