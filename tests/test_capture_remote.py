@@ -448,3 +448,32 @@ class TestPaginationAndSafety:
         ])
         assert args.fn(args) == 0
         assert (dst / "cap.tar.gz").read_bytes() == b"z"
+
+
+def test_s3_wire_query_matches_sigv4_canonical_encoding(monkeypatch):
+    """Regression for the round-3 advisor finding: the query string on
+    the wire must use the same percent-encoding as the canonical query
+    in _sign (space -> %20, '+' -> %2B, '/' -> %2F) — quote_plus-style
+    '+' for spaces makes SigV4 servers recompute a different canonical
+    string and reject the signature."""
+    import urllib.request
+
+    from retina_tpu.capture import remote as remote_mod
+    from retina_tpu.capture.remote import S3Store
+
+    seen: list[str] = []
+
+    def fake_request(req: urllib.request.Request, stream_to=None):
+        seen.append(req.full_url)
+        # Minimal empty ListV2 body so list() terminates.
+        return (b"<?xml version='1.0'?><ListBucketResult>"
+                b"</ListBucketResult>")
+
+    monkeypatch.setattr(remote_mod, "_request", fake_request)
+    store = S3Store("b", region="r", endpoint="http://127.0.0.1:1",
+                    access_key="k", secret_key="s")
+    store.list(prefix="my captures/file+name v2")
+    assert len(seen) == 1
+    q = seen[0].split("?", 1)[1]
+    assert "prefix=my%20captures%2Ffile%2Bname%20v2" in q
+    assert "+" not in q  # never quote_plus on a signed query
