@@ -435,3 +435,51 @@ def test_pipelined_window_close_ordered_with_steps():
     eng._close_window()
     run_on_device(eng._harvest_window)
     assert float(eng.last_window["entropy_bits"][0]) > 0.0
+
+
+def test_dead_dispatch_worker_drops_and_counts(monkeypatch):
+    """Failure injection for the dead-worker path (SURVEY §5.3): a
+    dispatch worker killed by a fatal error escaping its loop must not
+    wedge the feed loop — submissions drop with packet-weighted
+    lost_events accounting and the engine keeps running."""
+    from retina_tpu.engine import SketchEngine as Eng
+    from retina_tpu.exporter import reset_for_tests as reset_exporter
+    from retina_tpu.metrics import get_metrics, reset_for_tests
+
+    reset_exporter()
+    reset_for_tests()
+
+    def fatal_loop(self, q):  # simulates a runtime error escaping
+        raise RuntimeError("injected fatal dispatch error")
+
+    monkeypatch.setattr(Eng, "_dispatch_loop", fatal_loop)
+    cfg = small_cfg(feed_pipeline_depth=2, flush_interval_s=0.01)
+    eng = SketchEngine(cfg)
+    eng.update_identities({POD_NET + i: i for i in range(1, 20)})
+    eng.compile()
+    stop = threading.Event()
+    t = threading.Thread(target=eng.start, args=(stop,), daemon=True)
+    t.start()
+    assert eng.started.wait(2.0)
+    gen = TrafficGen(n_flows=100, n_pods=16, seed=5)
+    fed = 0
+    for _ in range(6):
+        eng.sink.write_records(gen.batch(400), "test")
+        fed += 400
+        time.sleep(0.05)
+    time.sleep(0.3)
+    assert t.is_alive(), "feed loop must survive a dead worker"
+    stop.set()
+    t.join(3.0)
+    assert not t.is_alive()
+    lost = get_metrics().lost_events.labels(
+        stage="dispatch", plugin="engine"
+    )._value.get()
+    # Sink losses (if the bounded sink overflowed) are counted at a
+    # different stage; everything the feed loop flushed must land in
+    # the dispatch-stage counter, packet-weighted.
+    sink_lost = get_metrics().lost_events.labels(
+        stage="sink", plugin="test"
+    )._value.get()
+    assert lost > 0
+    assert lost + sink_lost >= fed * 0.5, (lost, sink_lost, fed)
