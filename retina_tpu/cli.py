@@ -446,8 +446,21 @@ def cmd_observe(args: argparse.Namespace) -> int:
     last = args.last if args.last is not None else (0 if args.since else 20)
     try:
         for flow in client.get_flows(
-            filter=filt, last=last, follow=args.follow
+            filter=filt, last=last, follow=args.follow,
+            lost_markers=args.follow,
         ):
+            if "lost_events" in flow and "ip" not in flow:
+                # Ring-overwrite marker (the LostEvent analog): the
+                # reader fell behind and n flows were overwritten. In
+                # JSON mode it stays in-stream (machine consumers must
+                # see loss); in text mode it goes to stderr.
+                if args.json:
+                    print(json.dumps(flow))
+                else:
+                    print(f"{flow['lost_events']} flows lost "
+                          "(ring overwrite; reader too slow)",
+                          file=sys.stderr)
+                continue
             if args.json:
                 print(json.dumps(flow))
             else:

@@ -135,36 +135,50 @@ class FlowObserver:
         follow: bool = False,
         stop: Optional[threading.Event] = None,
         timeout_s: float = 30.0,
+        lost_markers: bool = False,
     ) -> Iterator[dict[str, Any]]:
         """Yield flows: the most recent ``last`` (0 = all buffered), then
         keep following if requested. A slow reader skips overwritten
-        entries (loss over blocking, like every ring in this system)."""
+        entries (loss over blocking, like every ring in this system);
+        with ``lost_markers`` each skip also yields a
+        ``{"lost_events": n}`` marker (the msgpack analog of the
+        protobuf surface's LostEvent response) that bypasses the filter
+        — consumers distinguish markers by that key."""
         with self._lock:
             end0 = self._seq
             window = min(end0, self._cap, last if last else self._cap)
             cursor = end0 - window
-        while True:
-            with self._lock:
-                if cursor < self._seq - self._cap:
-                    # Fell behind: skip (loss over blocking) and account
-                    # it (the reference's LostEvent with source
-                    # HUBBLE_RING_BUFFER).
-                    self.lost_observed += (self._seq - self._cap) - cursor
-                    cursor = self._seq - self._cap
-                limit = self._seq if follow else end0
-                batch = []
-                while cursor < limit:
-                    f = self._ring[cursor & (self._cap - 1)]
-                    if f is not None:
-                        batch.append((cursor, f))
-                    cursor += 1
-                if not batch and follow:
-                    self._lock.wait(timeout=0.2)
-            for seq, f in batch:
-                f = self._materialize(f, seq)
-                if filter is None or filter.matches(f):
-                    yield f
-            if not follow and cursor >= end0:
-                return
+        # Initial buffered window: one bounded scan (a lap between the
+        # snapshot and this scan surfaces as a marker too).
+        skipped = 0
+        with self._lock:
+            floor = self._seq - self._cap
+            if cursor < floor:
+                skipped = floor - cursor
+                self.lost_observed += skipped
+                cursor = floor
+            batch = []
+            while cursor < end0:
+                f = self._ring[cursor & (self._cap - 1)]
+                if f is not None:
+                    batch.append((cursor, f))
+                cursor += 1
+        if skipped and lost_markers:
+            yield {"lost_events": int(skipped)}
+        for seq, f in batch:
+            f = self._materialize(f, seq)
+            if filter is None or filter.matches(f):
+                yield f
+        if not follow:
+            return
+        # Follow phase: ONE implementation of the skip/account/emit
+        # contract lives in follow_from (also the protobuf surface's
+        # engine); this just maps its items onto the dict stream.
+        for kind, payload in self.follow_from(cursor, stop):
             if stop is not None and stop.is_set():
                 return
+            if kind == "lost":
+                if lost_markers:
+                    yield {"lost_events": int(payload)}
+            elif filter is None or filter.matches(payload):
+                yield payload

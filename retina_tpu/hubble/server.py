@@ -167,6 +167,7 @@ class HubbleServer:
                 last=int(req.get("last", 0)),
                 follow=bool(req.get("follow", False)),
                 stop=stop,
+                lost_markers=bool(req.get("lost_markers", False)),
             ):
                 if stop.is_set():
                     return
@@ -410,8 +411,13 @@ class HubbleClient:
         last: int = 0,
         follow: bool = False,
         timeout: Optional[float] = None,
+        lost_markers: bool = False,
     ) -> Iterator[dict[str, Any]]:
+        """With ``lost_markers``, ring-overwrite skips surface as
+        ``{"lost_events": n}`` dicts interleaved with the flows."""
         req = {"last": last, "follow": follow}
+        if lost_markers:
+            req["lost_markers"] = True
         if filter is not None:
             req["filter"] = filter.to_dict()
         for raw in self._get_flows(_pack(req), timeout=timeout):

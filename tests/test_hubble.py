@@ -258,3 +258,35 @@ def test_observer_lazy_decode_memoizes():
     # Second read returns identical objects (no re-decode).
     flows2, _ = obs.snapshot_flows()
     assert flows2[0] is flows[0]
+
+
+def test_msgpack_follow_lost_markers():
+    """The msgpack surface's analog of the protobuf LostEvent: a lapped
+    follower requesting lost_markers receives a {"lost_events": n}
+    marker dict (bypassing any filter) before newer flows resume."""
+    import numpy as np
+
+    obs = FlowObserver(capacity=1 << 6)  # 64-slot ring, easy to lap
+    srv = HubbleServer(obs, addr="127.0.0.1:0")
+    srv.start()
+    try:
+        client = HubbleClient(f"127.0.0.1:{srv.port}")
+        stream = client.get_flows(follow=True, lost_markers=True,
+                                  timeout=15)
+        it = iter(stream)
+        obs.consume(np.stack([mk_record(src="10.7.0.1")]))
+        first = next(it)
+        assert first["ip"]["source"] == "10.7.0.1"
+        # Lap the 64-slot ring in ONE consume (single lock hold): the
+        # floor is guaranteed past the reader's cursor with no chance
+        # for the server thread to drain between writes.
+        obs.consume(np.stack([mk_record(src="10.7.0.2")] * 256))
+        marker = None
+        for f in it:
+            if "lost_events" in f and "ip" not in f:
+                marker = f
+                break
+        assert marker is not None and marker["lost_events"] > 0
+        client.close()
+    finally:
+        srv.stop()
