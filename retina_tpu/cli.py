@@ -429,8 +429,12 @@ def cmd_observe(args: argparse.Namespace) -> int:
     client = HubbleClient(args.server)
     now_ns = time.time_ns()
     filt = FlowFilter(
-        pod=args.pod, namespace=args.namespace, verdict=args.verdict,
-        protocol=args.protocol, port=args.port, ip=args.ip,
+        pod=args.pod, namespace=args.namespace,
+        # Flow dicts carry upper-case verdict/protocol names; accept
+        # any case on the command line (hubble observe does).
+        verdict=args.verdict.upper() if args.verdict else None,
+        protocol=args.protocol.upper() if args.protocol else None,
+        port=args.port, ip=args.ip,
         event_type=args.type,
         # Clamped at the epoch: a span longer than wall-clock time means
         # "everything" (and negative ints overflow the msgpack wire).

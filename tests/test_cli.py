@@ -184,3 +184,37 @@ def test_observe_text_output_has_timestamp(capsys):
         assert "Nov" in stamped[0] and "10.0.0.1:1000 -> 10.0.0.2:80" in stamped[0]
     finally:
         srv.stop()
+
+
+def test_observe_filters_case_insensitive(capsys):
+    """--verdict/--protocol accept any case (flow dicts carry
+    upper-case names; hubble observe is forgiving the same way)."""
+    import numpy as np
+
+    from retina_tpu.events.schema import (
+        DIR_INGRESS, F, NUM_FIELDS, OP_FROM_NETWORK, PROTO_TCP,
+    )
+    from retina_tpu.hubble import FlowObserver, HubbleServer
+
+    obs = FlowObserver(capacity=1 << 8)
+    rec = np.zeros((3, NUM_FIELDS), np.uint32)
+    rec[:, F.SRC_IP] = 0x0A000001
+    rec[:, F.DST_IP] = 0x0A000002
+    rec[:, F.PORTS] = (1000 << 16) | 80
+    rec[:, F.META] = (
+        (PROTO_TCP << 24) | (OP_FROM_NETWORK << 8) | (DIR_INGRESS << 4)
+    )
+    rec[:, F.VERDICT] = 1  # FORWARDED
+    rec[:, F.PACKETS] = 1
+    obs.consume(rec)
+    srv = HubbleServer(obs, addr="127.0.0.1:0")
+    srv.start()
+    try:
+        assert main(["observe", "--server", f"127.0.0.1:{srv.port}",
+                     "--verdict", "forwarded", "--protocol", "tcp",
+                     "--json"]) == 0
+        lines = [l for l in capsys.readouterr().out.splitlines()
+                 if l.strip()]
+        assert len(lines) == 3
+    finally:
+        srv.stop()
