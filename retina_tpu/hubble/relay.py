@@ -55,6 +55,13 @@ class HubbleRelay:
             self.observer, addr=addr, node_name=node_name,
             peers=self.peer_list,
         )
+        # Loss reported BY peers (their ring lapped this relay): without
+        # this the cluster view silently reads complete while a node
+        # dropped flows on the way here.
+        self.peer_lost = 0
+        self.server.m_lost.labels(source="PEER_STREAM").set_function(
+            lambda: self.peer_lost
+        )
 
     def peer_list(self) -> list[dict[str, str]]:
         with self._peer_lock:
@@ -88,7 +95,16 @@ class HubbleRelay:
                     if self._stop.is_set():
                         stream.cancel()
                         break
-                    if resp.WhichOneof("response_types") != "flow":
+                    kind = resp.WhichOneof("response_types")
+                    if kind == "lost_events":
+                        n = int(resp.lost_events.num_events_lost)
+                        with self._peer_lock:  # one follower per peer
+                            self.peer_lost += n
+                        self._log.warning(
+                            "peer %s reported %d flows lost", name, n
+                        )
+                        continue
+                    if kind != "flow":
                         continue
                     # Per-response flush: a quiet peer's flows must not
                     # sit in a local batch on the never-ending stream.

@@ -290,3 +290,35 @@ def test_msgpack_follow_lost_markers():
         client.close()
     finally:
         srv.stop()
+
+
+def test_relay_accounts_peer_reported_loss():
+    """Loss reported BY a peer (its ring lapped the relay's follower)
+    must surface at the relay — hubble_lost_events_total with
+    source=PEER_STREAM — instead of reading as a complete cluster
+    view."""
+    from retina_tpu.exporter import get_exporter
+    from retina_tpu.hubble.relay import HubbleRelay
+
+    obs = FlowObserver(capacity=1 << 3)  # 8-slot ring: trivially lapped
+    srv = HubbleServer(obs, addr="127.0.0.1:0")
+    srv.start()
+    relay = None
+    try:
+        relay = HubbleRelay(
+            peers=[{"name": "node-a",
+                    "address": f"127.0.0.1:{srv.port}"}],
+            addr="127.0.0.1:0", node_name="relay-test",
+        )
+        relay.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and relay.peer_lost == 0:
+            obs.consume(np.stack([mk_record()] * 64))  # laps every time
+            time.sleep(0.2)
+        assert relay.peer_lost > 0, "peer LostEvent never accounted"
+        text = get_exporter().gather_hubble_text().decode()
+        assert 'hubble_lost_events_total{source="PEER_STREAM"}' in text
+    finally:
+        if relay is not None:
+            relay.stop()
+        srv.stop()
