@@ -437,6 +437,10 @@ def test_pipelined_window_close_ordered_with_steps():
     assert float(eng.last_window["entropy_bits"][0]) > 0.0
 
 
+@pytest.mark.filterwarnings(
+    # The injected fatal error escaping the worker thread IS the test.
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
 def test_dead_dispatch_worker_drops_and_counts(monkeypatch):
     """Failure injection for the dead-worker path (SURVEY §5.3): a
     dispatch worker killed by a fatal error escaping its loop must not
