@@ -202,25 +202,41 @@ def test_grafana_dashboards_reference_real_metrics():
         # Derive every queryable sample name from the registries'
         # metric families WITH their types: labeled-but-unobserved
         # metrics emit no sample lines, so text parsing would miss them.
-        exposed = set()
-        for reg in (ex.default_registry, ex.advanced_registry):
+        def queryable_names(reg):
             for fam in reg.collect():
                 if fam.type == "counter":
-                    exposed.add(fam.name + "_total")
+                    yield fam.name + "_total"
                 elif fam.type == "histogram":
-                    exposed.update({fam.name + s
-                                    for s in ("_bucket", "_sum",
-                                              "_count")})
+                    yield from (fam.name + s
+                                for s in ("_bucket", "_sum", "_count"))
                 else:
-                    exposed.add(fam.name)
+                    yield fam.name
+        # hubble_* series ground truth: the families the HubbleServer
+        # registers into the dedicated hubble registry — created via
+        # the registration seam alone (no gRPC server/socket).
+        from types import SimpleNamespace
+
+        from retina_tpu.exporter import get_exporter
+        from retina_tpu.hubble import FlowObserver, HubbleServer
+
+        HubbleServer._init_self_metrics(
+            SimpleNamespace(observer=FlowObserver(capacity=8))
+        )
+        exposed = set()
+        for reg in (ex.default_registry, ex.advanced_registry,
+                    get_exporter().hubble_registry):
+            exposed.update(queryable_names(reg))
         dash_dir = os.path.join(DEPLOY, "..", "grafana-dashboards")
         boards = sorted(glob.glob(os.path.join(dash_dir, "*.json")))
-        assert len(boards) >= 4  # sketches + pod-level + dns + cluster
+        names = {os.path.basename(p) for p in boards}
+        # sketches + pod-level + dns + cluster + engine + hubble
+        assert len(boards) >= 6 and "retina-tpu-hubble.json" in names
         unknown = {}
         for path in boards:
             text = open(path).read()
             for name in set(re.findall(
-                    r"networkobservability_[a-z0-9_]+", text)):
+                    r"(?:networkobservability|hubble)_[a-z0-9_]+",
+                    text)):
                 if name not in exposed:
                     unknown.setdefault(os.path.basename(path),
                                        []).append(name)
