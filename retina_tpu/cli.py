@@ -673,6 +673,21 @@ def cmd_deploy_render(args: argparse.Namespace) -> int:
         values_files=args.values or [],
         set_values=args.set or [],
     )
+    if args.output_dir:
+        # One file per template (helm template --output-dir shape):
+        # plays well with kustomize/kubectl-apply -f DIR pipelines.
+        os.makedirs(args.output_dir, exist_ok=True)
+        for name, body in rendered.items():
+            if name == "NOTES.txt":
+                continue
+            # render_chart keys are flat template basenames
+            # (helmlite renders templates/ non-recursively).
+            dst = os.path.join(args.output_dir, name)
+            with open(dst, "w") as f:
+                f.write(f"# Source: {name}\n")
+                f.write(body.strip("\n") + "\n")
+            print(dst)
+        return 0
     first = True
     for name, body in rendered.items():
         if name == "NOTES.txt":
@@ -862,6 +877,9 @@ def build_parser() -> argparse.ArgumentParser:
     dr.add_argument("--namespace", default=None)
     dr.add_argument("--values", action="append", metavar="FILE")
     dr.add_argument("--set", action="append", metavar="key=val")
+    dr.add_argument("--output-dir", default="",
+                    help="write one file per template instead of "
+                         "printing one multi-doc stream")
     dr.set_defaults(fn=cmd_deploy_render)
 
     v = sub.add_parser("version")

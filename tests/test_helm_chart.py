@@ -195,3 +195,25 @@ def test_cli_deploy_render(capsys):
     docs = [d for d in yaml.safe_load_all(out) if d]
     op = named(docs, "Deployment", "retina-tpu-operator")
     assert op["spec"]["replicas"] == 5
+
+
+def test_cli_deploy_render_output_dir(tmp_path, capsys):
+    """--output-dir writes one file per template (helm template
+    --output-dir shape) and each file is valid YAML."""
+    from retina_tpu.cli import build_parser
+
+    out_dir = tmp_path / "manifests"
+    args = build_parser().parse_args(
+        ["deploy", "render", "--chart", CHART,
+         "--output-dir", str(out_dir)]
+    )
+    assert args.fn(args) == 0
+    written = sorted(p.name for p in out_dir.iterdir())
+    assert "daemonset.yaml" in written and "configmap.yaml" in written
+    docs = []
+    for p in out_dir.iterdir():
+        docs.extend(d for d in yaml.safe_load_all(p.read_text()) if d)
+    assert named(docs, "Deployment", "retina-tpu-operator")
+    # The printed listing names every written file.
+    listed = capsys.readouterr().out.strip().splitlines()
+    assert len(listed) == len(written)
