@@ -1,0 +1,47 @@
+"""Daemon-level checkpoint lifecycle (SURVEY §5.4): save on shutdown,
+resume on the next boot, and quarantine of unreadable checkpoints —
+the crash-loop guard at daemon.py's snapshot_dir block."""
+
+import os
+import time
+
+from agentboot import running_agent
+from retina_tpu.config import Config
+from retina_tpu.e2e.steps import small_agent_config
+
+
+def _cfg(tmp_path, **kw) -> Config:
+    return small_agent_config(
+        synthetic_rate=100_000, synthetic_flows=500,
+        snapshot_dir=str(tmp_path), **kw,
+    )
+
+
+def test_shutdown_checkpoint_resumes_across_boots(tmp_path):
+    path = tmp_path / "sketch_state.npz"
+    with running_agent(
+        _cfg(tmp_path, enabled_plugins=["packetparser"])
+    ) as (d, _):
+        eng = d.cm.engine
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and eng._events_in == 0:
+            time.sleep(0.1)
+        assert eng._events_in > 0
+        time.sleep(0.3)
+        fed = int(eng.snapshot(max_age_s=0)["totals"][0])
+        assert fed > 0
+    assert path.exists(), "shutdown must write the checkpoint"
+
+    # Boot 2 with NO event source: totals must come from the resume.
+    with running_agent(_cfg(tmp_path, enabled_plugins=[])) as (d2, _):
+        snap = d2.cm.engine.snapshot(max_age_s=0)
+        assert int(snap["totals"][0]) >= fed
+
+
+def test_corrupt_checkpoint_quarantined_not_crash(tmp_path):
+    path = tmp_path / "sketch_state.npz"
+    path.write_bytes(b"this is not an npz archive")
+    with running_agent(_cfg(tmp_path, enabled_plugins=[])) as (d, _):
+        assert d.cm.engine.started.is_set()
+        assert int(d.cm.engine.snapshot(max_age_s=0)["totals"][0]) == 0
+    assert os.path.exists(str(path) + ".bad"), "quarantine rename"
