@@ -1,0 +1,34 @@
+# Developer entry points. CI runs the same commands
+# (.github/workflows/); the driver runs bench.py directly.
+
+.PHONY: test native bench bench-smoke soak distributed lint clean
+
+native:
+	$(MAKE) -C retina_tpu/native
+
+test: native
+	python -m pytest tests/ -q
+
+# Real-TPU benchmark (one JSON line; device step + e2e system number).
+bench: native
+	python bench.py
+
+bench-smoke: native
+	python bench.py --smoke
+
+# 5-minute paced soak with rate/loss/RSS/scrape budgets.
+soak: native
+	RETINA_SOAK=1 RETINA_SOAK_SECONDS=300 \
+	    python -m pytest tests/test_soak.py -q
+
+# Two-process jax.distributed mesh test (spawns 2 JAX procs).
+distributed:
+	RETINA_DISTRIBUTED_TESTS=1 \
+	    python -m pytest tests/test_distributed_two_process.py -q
+
+# Critical-error gate (matches .github/workflows/lint.yaml).
+lint:
+	python -m compileall -q retina_tpu tests bench.py __graft_entry__.py
+
+clean:
+	$(MAKE) -C retina_tpu/native clean
