@@ -26,6 +26,7 @@ from retina_tpu.events.schema import (
     EV_DNS_RESP,
     EV_DROP,
     EV_FORWARD,
+    EV_TCP_RETRANS,
     F,
     NUM_FIELDS,
     OP_FROM_NETWORK,
@@ -292,6 +293,36 @@ def test_scenario_ddos_entropy_anomaly():
         ScrapeAssert(
             mn.ANOMALY_WINDOWS, labels={"dimension": "src_ip"},
             value=lambda v: v >= 1.0, timeout_s=20.0,
+        ),
+        AssertNoCrashes(),
+    )).run()
+
+
+def test_scenario_tcp_retransmissions():
+    """Retrans scenario (reference test/e2e/scenarios/tcp analog):
+    retransmitted segments toward pod-b must surface as
+    adv_tcpretrans_count with pod identity, while the same segments
+    still count as ordinary forwards."""
+
+    def retrans():
+        rec = base_records(40, src_ip="10.8.8.8", dst_ip=POD_B_IP)
+        rec[:, F.EVENT_TYPE] = EV_TCP_RETRANS
+        return rec
+
+    Runner(Job("tcp-retrans-scenario").add(
+        BootAgent(),
+        WaitReady(),
+        RegisterPods(PODS),
+        InjectRecords(retrans),
+        ScrapeAssert(
+            mn.ADV_TCP_RETRANS_COUNT,
+            labels={"podname": "pod-b", "namespace": "default"},
+            value=40.0,
+        ),
+        ScrapeAssert(
+            mn.ADV_FORWARD_COUNT,
+            labels={"podname": "pod-b", "direction": "ingress"},
+            value=40.0,
         ),
         AssertNoCrashes(),
     )).run()
