@@ -3,7 +3,7 @@
 Reference analog: test/e2e/scenarios/drop/scenario.go:19-60 (deny-all
 netpol + curl → assert networkobservability_drop_count via Prometheus
 scrape with retry, framework/prometheus/prometheus.go:25-50), plus the
-dns, tcp-flags, and latency scenarios. Each scenario here is a Job of
+dns, tcp-flags, latency, and tcp-retrans scenarios. Each scenario here is a Job of
 typed steps (retina_tpu/e2e/) executed by the Runner; every assertion
 reads the production HTTP exposition surface, never Python internals.
 """
