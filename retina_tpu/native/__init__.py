@@ -310,6 +310,18 @@ class NativeFlowDict:
         ids = np.zeros(n, np.uint32)
         is_new = np.zeros(n, np.uint8)
         if n:
+            # Same contract as HostFlowDict: accept (N, >=16) of any int
+            # dtype — rt_flowdict_assign reads row-major (n,16) u32, so
+            # anything wider/non-u32 must be sliced+cast first or the C++
+            # side would misread the rows.
+            if records.ndim != 2 or records.shape[1] < NUM_FIELDS:
+                raise ValueError(
+                    f"expected (N, >={NUM_FIELDS}) records, got "
+                    f"{records.shape}"
+                )
+            if (records.dtype != np.uint32
+                    or records.shape[1] != NUM_FIELDS):
+                records = records[:, :NUM_FIELDS].astype(np.uint32)
             if not records.flags.c_contiguous:
                 records = np.ascontiguousarray(records)
             self._lib.rt_flowdict_assign(

@@ -13,8 +13,9 @@ a per-event object pipeline.
 Struct layouts follow Cilium's stable datapath ABI (pkg/monitor/
 datapath_drop.go / datapath_trace.go / datapath_policy.go): DropNotify
 (36-byte header), TraceNotify V0/V1 (32/48 bytes, version at offset 14),
-PolicyVerdictNotify (32 bytes). Offsets live in one table below so an
-ABI revision is a one-line fix.
+PolicyVerdictNotify (32 bytes), DebugCapture (24 bytes, its own layout —
+datapath_debug.go). Offsets live in one table below so an ABI revision
+is a one-line fix.
 """
 
 from __future__ import annotations
@@ -60,12 +61,12 @@ MSG_TRACE_SOCK = 9
 # TraceFrom*) -> our OP_* / direction. Unlisted points keep
 # OP_FROM_NETWORK + DIR_UNKNOWN.
 _TRACE_OBS = {
-    0: (OP_TO_STACK, DIR_EGRESS),  # to-endpoint's host side (to-lxc)
+    0: (OP_TO_ENDPOINT, DIR_INGRESS),  # to-lxc: delivery INTO the endpoint
     2: (OP_TO_STACK, DIR_EGRESS),  # to-host
     3: (OP_TO_STACK, DIR_EGRESS),  # to-stack
     4: (OP_TO_NETWORK, DIR_EGRESS),  # to-overlay
     11: (OP_TO_NETWORK, DIR_EGRESS),  # to-network
-    5: (OP_TO_ENDPOINT, DIR_INGRESS),  # from-lxc
+    5: (OP_TO_STACK, DIR_EGRESS),  # from-lxc: packet LEAVING the endpoint
     7: (OP_FROM_NETWORK, DIR_INGRESS),  # from-host
     8: (OP_FROM_NETWORK, DIR_INGRESS),  # from-stack
     9: (OP_FROM_NETWORK, DIR_INGRESS),  # from-overlay
@@ -117,6 +118,9 @@ _DROP_HDR = 36  # DropNotify: ...DstID u32, Line u16, File u8,
 _TRACE_HDR_V0 = 32  # TraceNotify: version at offset 14
 _TRACE_HDR_V1 = 48  # V1 appends OrigIP [16]byte
 _POLICY_HDR = 32  # PolicyVerdictNotify (datapath_policy.go)
+_DEBUG_CAP_HDR = 24  # DebugCapture: Type u8, SubType u8, Source u16,
+#                      Hash u32, Len u32, OrigLen u32, Arg1 u32, Arg2 u32
+#                      (datapath_debug.go) — NOT the TraceNotify layout
 
 
 @dataclasses.dataclass
@@ -157,7 +161,7 @@ def parse_perf_sample(data: bytes) -> ParsedEvent | None:
             direction=DIR_UNKNOWN,
             ifindex=ifindex,
         )
-    if msg in (MSG_TRACE, MSG_CAPTURE, MSG_RECORD_CAPTURE):
+    if msg == MSG_TRACE:
         if len(data) < _TRACE_HDR_V0:
             return None
         version = struct.unpack_from("<H", data, 14)[0]
@@ -176,6 +180,18 @@ def parse_perf_sample(data: bytes) -> ParsedEvent | None:
             direction=direction,
             ifindex=ifindex,
         )
+    if msg == MSG_CAPTURE:
+        # DebugCapture: only emitted with datapath debug enabled; its
+        # 24-byte header has no version field and no ifindex.
+        if len(data) < _DEBUG_CAP_HDR:
+            return None
+        return ParsedEvent(
+            frame=data[_DEBUG_CAP_HDR:],
+            event_type=EV_FORWARD,
+            verdict=VERDICT_FORWARDED,
+            obs_point=OP_FROM_NETWORK,
+            direction=DIR_UNKNOWN,
+        )
     if msg == MSG_POLICY_VERDICT:
         if len(data) < _POLICY_HDR:
             return None
@@ -192,7 +208,10 @@ def parse_perf_sample(data: bytes) -> ParsedEvent | None:
             event_type=EV_FORWARD,
             verdict=VERDICT_FORWARDED,
         )
-    return None  # debug / agent / trace-sock / access-log
+    # debug / agent / trace-sock / access-log, and MSG_RECORD_CAPTURE
+    # (pcap-recorder captures use their own RecordCapture layout — not
+    # yet supported, dropped rather than misparsed).
+    return None
 
 
 _PCAP_HDR = struct.pack(

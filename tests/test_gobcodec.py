@@ -270,6 +270,40 @@ def test_policy_verdict_negative_is_drop():
 def test_non_packet_messages_skipped():
     assert parse_perf_sample(bytes([2]) + b"\x00" * 64) is None  # debug
     assert parse_perf_sample(b"") is None
+    # MSG_RECORD_CAPTURE (8) has its own RecordCapture layout; it must
+    # be skipped, not misparsed with the TraceNotify offsets.
+    assert parse_perf_sample(bytes([8]) + b"\x00" * 64) is None
+
+
+def test_debug_capture_uses_24_byte_header():
+    """MSG_CAPTURE (3) is DebugCapture — 24-byte header, no version
+    field — so the embedded frame starts at offset 24, NOT the
+    TraceNotify 32/48 (ADVICE r4)."""
+    hdr = bytearray(24)
+    hdr[0] = 3  # MSG_CAPTURE
+    ev = parse_perf_sample(bytes(hdr) + _udp_frame(src="10.2.0.7"))
+    assert ev is not None
+    rec, _ = events_to_records([ev])
+    assert len(rec) == 1, "frame misaligned: header length wrong"
+    assert rec[0, F.SRC_IP] == ip_to_u32("10.2.0.7")
+    assert rec[0, F.EVENT_TYPE] == EV_FORWARD
+    # Truncated header -> skipped.
+    assert parse_perf_sample(bytes([3]) + b"\x00" * 10) is None
+
+
+def test_trace_obs_points_not_inverted():
+    """to-lxc (0) is delivery INTO the endpoint (ingress); from-lxc (5)
+    is the packet LEAVING the endpoint (egress) — ADVICE r4."""
+    from retina_tpu.events.schema import (
+        DIR_EGRESS, DIR_INGRESS, OP_TO_ENDPOINT, OP_TO_STACK,
+    )
+
+    to_lxc = parse_perf_sample(_trace_data(_udp_frame(), obs=0))
+    from_lxc = parse_perf_sample(_trace_data(_udp_frame(), obs=5))
+    assert (to_lxc.obs_point, to_lxc.direction) == (
+        OP_TO_ENDPOINT, DIR_INGRESS)
+    assert (from_lxc.obs_point, from_lxc.direction) == (
+        OP_TO_STACK, DIR_EGRESS)
 
 
 def test_event_index_survives_undecodable_frames():
