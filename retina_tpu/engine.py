@@ -183,6 +183,9 @@ class SketchEngine:
         self.last_window: dict[str, np.ndarray] = {}
         self._state_lock = threading.Lock()
         self.started = threading.Event()
+        # Set once start_background_warm has every reachable bucket key
+        # compiled (tests and shutdown fences).
+        self.bucket_warm_done = threading.Event()
         self._steps = 0
         self._events_in = 0
         self._closed_events_in = 0
@@ -288,8 +291,18 @@ class SketchEngine:
 
     # -- lifecycle ----------------------------------------------------
     def compile(self) -> None:
-        """Warm every jit cache (the clang-compile analog) so the feed
-        loop and the first scrape never pay compile latency."""
+        """Warm the STEADY-STATE jit keys (the clang-compile analog) so
+        the feed loop and the first scrape never pay compile latency:
+        the full-capacity step, the window close + both snapshot
+        programs, and the minimum wire bucket for every dispatch path.
+
+        Deliberately NOT warmed here: the rest of the bucket grid.
+        Warming every reachable bucket on the boot critical path cost a
+        96s agent boot on a cold persistent cache (BENCH_r04) against
+        the reference's 10s plugin-reconcile SLA
+        (pluginmanager.go:25-28); the daemon warms the remaining grid in
+        the background AFTER ready (start_background_warm), one proxy
+        call per key so live dispatches interleave."""
         t0 = time.perf_counter()
         # Full-capacity dispatch (the steady-state jit key: packed-wire
         # ingest at bucket == batch_capacity + the step with
@@ -319,53 +332,111 @@ class SketchEngine:
             self.sharded.snapshot_host(self.state, 1)
 
         run_on_device(warm)
-        # Warm the bucketed-ingest jits (wire unpack + pad) for the
-        # smallest bucket plus every coalesced bucket ABOVE capacity the
-        # feed loop can produce under saturation (shape-spec AOT: no
-        # data crosses the link). Small mid-range buckets still compile
-        # on first use (tiny kernels, persistent-cached) — only the
-        # multi-window keys are big enough for a cold compile to stall
-        # the proxy thread mid-feed.
+        # Warm the smallest plain bucket (idle/interval flushes); the
+        # rest of the bucket ladder is start_background_warm's job.
         self._dispatch(
             np.zeros((0, NUM_FIELDS), np.uint32), now_s=1,
             record_metrics=False,
         )
-        coal_cap = (
-            self.cfg.batch_capacity
-            * max(1, self.cfg.feed_coalesce_windows)
-        )
         if self._flow_dict is not None:
-            # Flow-dict mode: warm the new/known ingest grid. Steady
-            # state puts the known bucket near quantum/combine_ratio
-            # (often BELOW batch_capacity) and the new bucket at the
-            # minimum, but warm the full upper grid so a churn burst
-            # never cold-compiles on the proxy thread mid-feed.
-            grid = {self._wire_bucket(0)}
-            b = max(
-                self.cfg.batch_capacity // 8,
-                self.cfg.transfer_min_bucket,
-            )
-            grid.add(self._wire_bucket(b))
-            while b < coal_cap:
-                b = min(_next_bucket(b + 1), coal_cap)
-                grid.add(b)
-            for b in sorted(grid):
-                run_on_device(self._ingest_new_fn, b)
-                run_on_device(self._ingest_known_fn, b)
-        elif self.cfg.feed_coalesce_windows > 1:
-            packed = bool(self.cfg.transfer_packed)
-            b = self.cfg.batch_capacity
-            seen = set()
-            while b < coal_cap:
-                b = min(_next_bucket(b + 1), coal_cap)
-                if b not in seen:
-                    seen.add(b)
-                    run_on_device(self._ingest_fn, b, packed)
+            # The idle/low-rate flush keys: a steady trickle produces
+            # min-bucket new+known pairs on every interval flush.
+            b0 = self._wire_bucket(0)
+            run_on_device(self._ingest_new_fn, b0)
+            run_on_device(self._ingest_known_fn, b0)
         self.log.info(
             "engine compiled: %d device(s), batch=%d, %.1fs",
             self.n_devices, self.cfg.batch_capacity,
             time.perf_counter() - t0,
         )
+
+    def _reachable_buckets(self) -> list[int]:
+        """Every wire bucket a dispatch can produce: the quantized
+        ladder (_next_bucket) from the minimum transfer bucket up to
+        batch_capacity * feed_coalesce_windows, inclusive."""
+        coal_cap = (
+            self.cfg.batch_capacity
+            * max(1, self.cfg.feed_coalesce_windows)
+        )
+        b = self._wire_bucket(0)
+        out = [b]
+        while b < coal_cap:
+            b = min(_next_bucket(b + 1), coal_cap)
+            out.append(b)
+        return out
+
+    def start_background_warm(
+        self, stop: threading.Event | None = None
+    ) -> threading.Thread:
+        """Warm every remaining reachable bucket key OFF the boot
+        critical path (VERDICT r4 #2: agent ready in <=15s).
+
+        Runs on its own thread, one ``run_on_device`` per key, smallest
+        bucket first: the proxy queue is FIFO, so a live dispatch waits
+        behind at most ONE in-flight warm compile, and a post-ready feed
+        ramps through the small/mid buckets before saturation reaches
+        the multi-window keys — warming in ramp order (small keys also
+        compile fastest) keeps the window where a reachable bucket is
+        still cold as short as possible. A bucket the feed reaches
+        before its warm simply compiles inline exactly as it would
+        have — the warm then finds the key cached and skips it.
+        ``bucket_warm_done`` is set when the grid is fully resident
+        (tests fence on it). ``stop`` is checked between keys; an
+        IN-FLIGHT compile cannot be aborted, so a shutdown racing the
+        warm still waits for at most one key."""
+        def _warm() -> None:
+            t0 = time.perf_counter()
+            n_warmed = 0
+            n_failed = 0
+            try:
+                for b in self._reachable_buckets():
+                    if self._flow_dict is not None:
+                        jobs = [
+                            (("known", b), self._ingest_known_fn, (b,)),
+                            (("new", b), self._ingest_new_fn, (b,)),
+                        ]
+                    else:
+                        packed = bool(self.cfg.transfer_packed)
+                        jobs = [
+                            ((b, packed), self._ingest_fn, (b, packed)),
+                        ]
+                    for key, fn, args in jobs:
+                        if stop is not None and stop.is_set():
+                            return
+                        if key in self._pad_cache:
+                            continue
+                        try:
+                            run_on_device(fn, *args)
+                            n_warmed += 1
+                        except Exception:
+                            n_failed += 1
+                            self.log.exception(
+                                "background warm failed at %s", key
+                            )
+                if n_failed:
+                    # A failed key means a reachable bucket can still
+                    # cold-compile mid-feed — the done event must NOT
+                    # claim otherwise.
+                    self.log.warning(
+                        "bucket grid warm incomplete: %d key(s) failed",
+                        n_failed,
+                    )
+                    return
+                self.bucket_warm_done.set()
+                if n_warmed:
+                    self.log.info(
+                        "bucket grid warm: %d key(s) in %.1fs "
+                        "(background)",
+                        n_warmed, time.perf_counter() - t0,
+                    )
+            except Exception:
+                self.log.exception("background bucket warm died")
+
+        t = threading.Thread(
+            target=_warm, name="engine-bucket-warm", daemon=True
+        )
+        t.start()
+        return t
 
     def step_records(self, records: np.ndarray, now_s: int | None = None) -> None:
         """Feed one host block synchronously (tests / direct callers)."""

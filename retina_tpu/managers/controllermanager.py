@@ -57,6 +57,7 @@ class ControllerManager:
         self.server: Optional[Server] = None
         self._ready = threading.Event()
         self._engine_thread: Optional[threading.Thread] = None
+        self._warm_thread: Optional[threading.Thread] = None
 
         # Identity churn → debounced device table rebuild (the enricher's
         # cache lookup seam, enricher.go:102-135, now a device upload).
@@ -149,6 +150,9 @@ class ControllerManager:
         self.pluginmanager.start(stop)
         self._ready.set()
         self._log.info("agent ready on %s", self.cfg.api_server_addr)
+        # The rest of the bucket grid compiles AFTER ready, interleaved
+        # with live dispatches (VERDICT r4 #2: boot SLA over grid warm).
+        self._warm_thread = self.engine.start_background_warm(stop)
         stop.wait()
         self.shutdown()
 
@@ -157,13 +161,32 @@ class ControllerManager:
         self.pluginmanager.stop()
         if self._engine_thread is not None:
             self._engine_thread.join(timeout=3.0)
+        if self._warm_thread is not None:
+            # stop is set by now, so the warm exits at the next key
+            # boundary; joining keeps the shutdown snapshot from queuing
+            # behind more than the one in-flight warm compile.
+            self._warm_thread.join(timeout=10.0)
         if self.cfg.snapshot_dir:
-            try:
-                self.engine.save_snapshot_state(
-                    f"{self.cfg.snapshot_dir}/sketch_state.npz"
+            from retina_tpu.utils.device_proxy import fence
+
+            # An in-flight warm compile (cold cache: 30-100s on the
+            # tunnel) cannot be aborted and would hold the FIFO proxy
+            # queue past a k8s termination grace window. The state at
+            # that point is minutes of boot traffic — skipping the save
+            # (quarantine-equivalent: next boot starts fresh) beats a
+            # SIGKILL mid-write.
+            if not fence(timeout=15.0):
+                self._log.warning(
+                    "device proxy busy (warm compile in flight); "
+                    "skipping shutdown state snapshot"
                 )
-            except Exception:
-                self._log.exception("shutdown state snapshot failed")
+            else:
+                try:
+                    self.engine.save_snapshot_state(
+                        f"{self.cfg.snapshot_dir}/sketch_state.npz"
+                    )
+                except Exception:
+                    self._log.exception("shutdown state snapshot failed")
         if self.server is not None:
             self.server.stop()
         self.telemetry.stop()

@@ -1,0 +1,106 @@
+"""Boot-latency contract (VERDICT r4 #2): ``compile()`` warms only the
+steady-state jit keys — the agent is ready in seconds, not after the
+full bucket grid — and ``start_background_warm`` then makes EVERY
+reachable bucket key resident so no live dispatch can hit a cold compile
+once the warm finishes.
+
+Reference SLA spirit: pkg/managers/pluginmanager/pluginmanager.go:25-28
+(the whole plugin reconcile budget is 10s)."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from retina_tpu.config import Config
+from retina_tpu.engine import SketchEngine
+from retina_tpu.events.synthetic import TrafficGen
+
+
+def small_cfg(**kw) -> Config:
+    cfg = Config()
+    cfg.batch_capacity = 1 << 10
+    cfg.n_pods = 1 << 6
+    cfg.cms_width = 1 << 10
+    cfg.cms_depth = 2
+    cfg.topk_slots = 1 << 6
+    cfg.hll_precision = 8
+    cfg.entropy_buckets = 1 << 8
+    cfg.conntrack_slots = 1 << 10
+    cfg.identity_slots = 1 << 8
+    cfg.flow_dict_slots = 1 << 12
+    cfg.transfer_min_bucket = 64
+    cfg.bypass_lookup_ip_of_interest = True
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_compile_warms_only_steady_state_keys():
+    """The boot critical path compiles the full-capacity step, the min
+    plain bucket, and the min new/known pair — nothing from the upper
+    grid (that was the 96s boot of BENCH_r04)."""
+    eng = SketchEngine(small_cfg(feed_coalesce_windows=4))
+    eng.compile()
+    b0 = eng._wire_bucket(0)
+    keys = set(eng._pad_cache)
+    assert ("new", b0) in keys and ("known", b0) in keys
+    upper = [
+        k for k in keys
+        if k[0] in ("new", "known") and k[1] > b0
+    ]
+    assert not upper, f"upper grid keys on the critical path: {upper}"
+    # Bounded: plain capacity key + plain min key + the min dict pair
+    # (+ nothing that scales with the grid).
+    assert len(keys) <= 5, sorted(keys, key=str)
+
+
+def test_background_warm_covers_every_reachable_bucket():
+    """After bucket_warm_done, any bucket the feed can produce — every
+    _wire_bucket(n) for n in [0, coal_cap] — must already be compiled:
+    no mid-feed cold compile at any reachable bucket. Live dispatches
+    interleave with the warm (FIFO proxy queue)."""
+    eng = SketchEngine(small_cfg(feed_coalesce_windows=2))
+    eng.compile()
+    t = eng.start_background_warm()
+    # Feed while the warm runs: dispatches must interleave, not wedge.
+    gen = TrafficGen(n_flows=200, n_pods=32, seed=7)
+    for i in range(3):
+        eng.step_records(gen.batch(512), now_s=10 + i)
+    assert eng.bucket_warm_done.wait(300.0), "background warm never done"
+    t.join(10.0)
+    coal_cap = eng.cfg.batch_capacity * eng.cfg.feed_coalesce_windows
+    probes = set(range(0, coal_cap + 1, 97)) | {0, 1, coal_cap}
+    for n in probes:
+        wb = eng._wire_bucket(n)
+        assert ("new", wb) in eng._pad_cache, (n, wb)
+        assert ("known", wb) in eng._pad_cache, (n, wb)
+    snap = eng.snapshot(max_age_s=0)
+    assert int(np.asarray(snap["totals"]).sum()) > 0
+
+
+def test_background_warm_plain_mode_covers_coalesced_buckets():
+    cfg = small_cfg(feed_coalesce_windows=3)
+    cfg.wire_flow_dict = False
+    eng = SketchEngine(cfg)
+    assert eng._flow_dict is None
+    eng.compile()
+    eng.start_background_warm()
+    assert eng.bucket_warm_done.wait(300.0)
+    packed = bool(cfg.transfer_packed)
+    for b in eng._reachable_buckets():
+        assert (b, packed) in eng._pad_cache, b
+
+
+def test_background_warm_stops_early_on_shutdown():
+    eng = SketchEngine(small_cfg(feed_coalesce_windows=2))
+    eng.compile()
+    stop = threading.Event()
+    stop.set()  # shutdown before the warm starts walking the grid
+    t = eng.start_background_warm(stop)
+    t.join(30.0)
+    assert not t.is_alive()
+    # Done is NOT set on an aborted warm — nobody may conclude the grid
+    # is resident.
+    assert not eng.bucket_warm_done.is_set()
