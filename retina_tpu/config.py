@@ -154,12 +154,13 @@ class Config:
     # 12-lane packed wire format (parallel/wire.py) instead of the 16-lane
     # schema layout; unpacked on device. Off only for debugging.
     transfer_packed: bool = True
-    # v2 wire: device-resident flow-descriptor dictionary. Each distinct
-    # combined-flow descriptor crosses the link ONCE (12 lanes + id);
-    # every later occurrence crosses as a 16-byte (id, packets, bytes,
-    # ts_rel) tuple and the descriptor lanes are gathered back from HBM
-    # (parallel/flowdict.py + engine ingest). Steady-state wire
-    # bytes/event drop ~3x on long-lived flows. Requires transfer_packed.
+    # v2/v3 wire: device-resident flow-descriptor dictionary. Each
+    # distinct combined-flow descriptor crosses the link ONCE (12 lanes
+    # + id); every later occurrence crosses as an 8-byte
+    # [id | packets << id_bits, bytes] pair and the descriptor lanes are
+    # gathered back from HBM (parallel/flowdict.py + engine ingest).
+    # Steady-state wire bytes/event drop ~6x on long-lived flows.
+    # Requires transfer_packed.
     wire_flow_dict: bool = True
     # Device descriptor-table slots (48 B/slot/device). Must exceed the
     # live distinct-descriptor count or the dictionary cycles

@@ -147,6 +147,18 @@ class SketchEngine:
             if cfg.transfer_packed and cfg.wire_flow_dict
             else None
         )
+        # v3 wire: known-flow rows are TWO u32 lanes — [id | packets <<
+        # id_bits, bytes] — 8 bytes/row instead of 16. Packets ride the
+        # id lane's headroom; rows whose packet count exceeds it (or any
+        # new descriptor) ship full rows instead (escalation is
+        # idempotent: re-scattering a resident descriptor is a no-op for
+        # correctness). Known rows' per-row timestamps are replaced by
+        # the flush's base timestamp; rows where exact per-row time
+        # matters — TSval/TSecr carriers (RTT matcher) and unstamped
+        # rows (TS_REL=0 round-trip) — escalate to the full-row side
+        # (see _dispatch_flowdict).
+        self._fd_id_bits = max(1, (cfg.flow_dict_slots - 1).bit_length())
+        self._fd_pk_bits = 32 - self._fd_id_bits
         self._fd_lock = threading.Lock()
         self._desc_table: Any = None
         # Bumped ONLY by failure resyncs (not by capacity-overflow
@@ -491,7 +503,7 @@ class SketchEngine:
             def ingest(small, meta):
                 if packed:
                     small = unpack_records_device(small, meta[0], meta[1])
-                nv = meta[4:].astype(jnp.int32)
+                nv = meta[5:].astype(jnp.int32)
                 wins, nvs = [], []
                 for w in range(n_win):
                     lo = w * cap
@@ -520,7 +532,7 @@ class SketchEngine:
                     sharding=self._rec_sharding,
                 ),
                 jax.ShapeDtypeStruct(
-                    (4 + self.n_devices,), jnp.uint32,
+                    (5 + self.n_devices,), jnp.uint32,
                     sharding=self._replicated,
                 ),
             ).compile()
@@ -614,7 +626,7 @@ class SketchEngine:
                 d_idx = jnp.arange(lanes.shape[0])[:, None]
                 table = table.at[d_idx, ids].set(lanes)
                 full = unpack_records_device(lanes, meta[0], meta[1])
-                nv = meta[4:].astype(jnp.int32)
+                nv = meta[5:].astype(jnp.int32)
                 wins, nvs = SketchEngine._slice_windows(
                     full, nv, bucket, cap
                 )
@@ -626,7 +638,7 @@ class SketchEngine:
                     jnp.uint32, sharding=self._rec_sharding,
                 ),
                 jax.ShapeDtypeStruct(
-                    (4 + self.n_devices,), jnp.uint32,
+                    (5 + self.n_devices,), jnp.uint32,
                     sharding=self._replicated,
                 ),
                 jax.ShapeDtypeStruct(
@@ -641,11 +653,13 @@ class SketchEngine:
         return fn
 
     def _ingest_known_fn(self, bucket: int):
-        """Per-bucket jit for KNOWN flows: (D, bucket, 4) wire of
-        [table_id, packets, bytes, ts_rel] + meta + descriptor table ->
-        gather the resident 12-lane descriptors from HBM, overlay the
-        per-quantum counters, unpack, slice into step windows. 16 bytes
-        per flow row on the link instead of 48.
+        """Per-bucket jit for KNOWN flows: (D, bucket, 2) wire of
+        [table_id | packets << id_bits, bytes] + meta + descriptor
+        table -> gather the resident 12-lane descriptors from HBM,
+        overlay the per-quantum counters, unpack, slice into step
+        windows. meta[4] is the biased TS_REL flag for every known row
+        (1 = stamped at the flush base meta[0:2], 0 = unstamped flush).
+        8 bytes per flow row on the link instead of 48 (v2 was 16).
 
         Reference analog: the kernel map hit path — established flows
         move counters only (conntrack.c ct_process_packet accumulate).
@@ -661,6 +675,8 @@ class SketchEngine:
                 PACKED_FIELDS, unpack_records_device,
             )
 
+            id_bits = jnp.uint32(self._fd_id_bits)
+            id_mask = jnp.uint32((1 << self._fd_id_bits) - 1)
             out_sh = (
                 (self._rec_sharding,) * n_win,
                 (self._rec_sharding,) * n_win,
@@ -670,14 +686,17 @@ class SketchEngine:
 
             @_partial(jax.jit, out_shardings=out_sh)
             def ingest(wire, meta, table):
-                ids = wire[..., 0]
+                ids = wire[..., 0] & id_mask
+                pk = wire[..., 0] >> id_bits
                 d_idx = jnp.arange(wire.shape[0])[:, None]
                 desc = table[d_idx, ids]  # (D, bucket, 12)
-                desc = desc.at[..., 6].set(wire[..., 1])  # PACKETS
-                desc = desc.at[..., 5].set(wire[..., 2])  # BYTES
-                desc = desc.at[..., 0].set(wire[..., 3])  # TS_REL
+                desc = desc.at[..., 6].set(pk)  # PACKETS
+                desc = desc.at[..., 5].set(wire[..., 1])  # BYTES
+                desc = desc.at[..., 0].set(
+                    jnp.broadcast_to(meta[4], ids.shape)  # TS_REL
+                )
                 full = unpack_records_device(desc, meta[0], meta[1])
-                nv = meta[4:].astype(jnp.int32)
+                nv = meta[5:].astype(jnp.int32)
                 wins, nvs = SketchEngine._slice_windows(
                     full, nv, bucket, cap
                 )
@@ -685,11 +704,11 @@ class SketchEngine:
 
             fn = ingest.lower(
                 jax.ShapeDtypeStruct(
-                    (self.n_devices, bucket, 4), jnp.uint32,
+                    (self.n_devices, bucket, 2), jnp.uint32,
                     sharding=self._rec_sharding,
                 ),
                 jax.ShapeDtypeStruct(
-                    (4 + self.n_devices,), jnp.uint32,
+                    (5 + self.n_devices,), jnp.uint32,
                     sharding=self._replicated,
                 ),
                 jax.ShapeDtypeStruct(
@@ -718,12 +737,12 @@ class SketchEngine:
     ) -> None:
         """Flow-dictionary dispatch: split the partitioned batch into
         new-descriptor rows (full 12-lane upload + table insert) and
-        known rows (16-byte counter tuples against the resident table).
-        Both ride one proxy submission, FIFO-ordered so inserts land
-        before gathers."""
-        from retina_tpu.parallel.wire import (
-            batch_ts_base, pack_records, ts_rel,
-        )
+        known rows (8-byte [id|packets, bytes] tuples against the
+        resident table — v3 wire, see __init__). Known rows whose packet
+        count overflows the id lane's headroom escalate to the new side
+        (idempotent re-scatter). Both ride one proxy submission,
+        FIFO-ordered so inserts land before gathers."""
+        from retina_tpu.parallel.wire import batch_ts_base, pack_records
 
         with self._ident_lock:
             ident = self.ident
@@ -745,17 +764,36 @@ class SketchEngine:
             fd_entries = len(self._flow_dict)
             fd_generation = self._flow_dict.generation
         base = batch_ts_base(sb.records)
-        n_new = [int(x[2].sum()) for x in per_dev]
+        pk_cap = np.uint32(1) << np.uint32(self._fd_pk_bits)
+        id_bits = np.uint32(self._fd_id_bits)
+        # Escalate to the full-row side (exact per-row fields) any known
+        # row the 8-byte lanes cannot represent faithfully: packet
+        # counts over the id lane's headroom, rows carrying TSval/TSecr
+        # (the RTT matcher needs their EXACT send time — the flush-base
+        # stamp below would record phantom times), and unstamped rows
+        # (TS_REL=0 must round-trip to ts 0, wire.py:17-23). The masks
+        # are computed once and reused for sizing + build. All in-tree
+        # sources stamp and TSval rows are apiserver-RTT traffic only,
+        # so escalation stays rare.
+        sel_new = [
+            x[2]
+            | (x[0][:, F.PACKETS] >= pk_cap)
+            | ((x[0][:, F.TSVAL] | x[0][:, F.TSECR]) != 0)
+            | ((x[0][:, F.TS_LO] | x[0][:, F.TS_HI]) == 0)
+            for x in per_dev
+        ]
+        n_new = [int(s.sum()) for s in sel_new]
         n_known = [len(x[0]) - nn for x, nn in zip(per_dev, n_new)]
         Bn = self._wire_bucket(max(n_new) if n_new else 0)
         Bk = self._wire_bucket(max(n_known) if n_known else 0)
         new_wire = np.zeros((D, Bn, 13), np.uint32)
-        known_wire = np.zeros((D, Bk, 4), np.uint32)
+        known_wire = np.zeros((D, Bk, 2), np.uint32)
         nv_new = np.zeros((D,), np.uint32)
         nv_known = np.zeros((D,), np.uint32)
-        for d, (rows, ids, is_new) in enumerate(per_dev):
-            rn, idn = rows[is_new], ids[is_new]
-            rk, idk = rows[~is_new], ids[~is_new]
+        for d, (rows, ids, _) in enumerate(per_dev):
+            sel = sel_new[d]
+            rn, idn = rows[sel], ids[sel]
+            rk, idk = rows[~sel], ids[~sel]
             if len(rn) > Bn or len(rk) > Bk:
                 # Unreachable from in-tree callers (partition capacity
                 # == the _wire_bucket cap). Dropping new rows here
@@ -772,10 +810,10 @@ class SketchEngine:
                 new_wire[d, : len(rn), 0] = idn
                 new_wire[d, : len(rn), 1:] = packed12
             if len(rk):
-                known_wire[d, : len(rk), 0] = idk
-                known_wire[d, : len(rk), 1] = rk[:, F.PACKETS]
-                known_wire[d, : len(rk), 2] = rk[:, F.BYTES]
-                known_wire[d, : len(rk), 3] = ts_rel(rk, base)
+                known_wire[d, : len(rk), 0] = (
+                    idk | (rk[:, F.PACKETS] << id_bits)
+                )
+                known_wire[d, : len(rk), 1] = rk[:, F.BYTES]
             nv_new[d] = len(rn)
             nv_known[d] = len(rk)
         if record_metrics and lost:
@@ -784,18 +822,25 @@ class SketchEngine:
             ).inc(lost)
         b_lo = np.uint32(base & np.uint64(0xFFFFFFFF))
         b_hi = np.uint32(base >> np.uint64(32))
-        meta_new = np.empty((4 + D,), np.uint32)
+        meta_new = np.empty((5 + D,), np.uint32)
         meta_new[0], meta_new[1] = b_lo, b_hi
         meta_new[2] = np.uint32(int(now_s) & 0xFFFFFFFF)
         meta_new[3] = np.uint32(int(lost) & 0xFFFFFFFF)
-        meta_new[4:] = nv_new
+        # Known rows' TS_REL: the flush base itself (rel 1 = "stamped,
+        # at base"; 0 = the whole flush is unstamped). A flush spans
+        # ~tens of ms, and rows needing exact per-row time (TSval/TSecr
+        # carriers, unstamped rows) escalated above, so one
+        # representative timestamp per flush is exact enough for
+        # conntrack/windowing.
+        meta_new[4] = 1 if int(base) > 0 else 0
+        meta_new[5:] = nv_new
         have_new = bool(nv_new.any())
         have_known = bool(nv_known.any())
         meta_known = meta_new.copy()
         # Host losses fold into the device totals exactly once: on the
         # new side when it runs, else on the known side.
         meta_known[3] = 0 if have_new else meta_new[3]
-        meta_known[4:] = nv_known
+        meta_known[5:] = nv_known
         n_events = int(sb.events)
         n_valid_total = int(nv_new.sum() + nv_known.sum())
 
@@ -987,11 +1032,12 @@ class SketchEngine:
         if record_metrics:
             m.transfer_bytes.inc(wire.nbytes)
         bucket = wire.shape[1]
-        meta = np.empty((4 + self.n_devices,), np.uint32)
+        meta = np.empty((5 + self.n_devices,), np.uint32)
         meta[0], meta[1] = b_lo, b_hi
         meta[2] = np.uint32(int(now_s) & 0xFFFFFFFF)
         meta[3] = np.uint32(int(sb.lost) & 0xFFFFFFFF)
-        meta[4:] = sb.n_valid
+        meta[4] = 0  # ts_rel_rep: unused on the full-row path
+        meta[5:] = sb.n_valid
         n_valid_total = int(sb.n_valid.sum())
         n_events = int(sb.events)
 

@@ -170,6 +170,78 @@ def test_dict_path_state_equals_plain_path():
     assert len(eng_dict._flow_dict) > 0
 
 
+def test_v3_known_rows_are_8_bytes_and_escalate_on_overflow():
+    """v3 wire: known rows ship as TWO u32 lanes (8 B/row). Packet
+    counts that overflow the id lane's headroom must ESCALATE to the
+    full-row side — never clamp — so pod packet counters stay exact."""
+    from retina_tpu.events.schema import F
+    from retina_tpu.metrics import get_metrics
+
+    kw = dict(topk_slots=1 << 9, data_aggregation_level="high")
+    gen = TrafficGen(n_flows=60, n_pods=24, seed=9)
+    # small_cfg slots = 2^12 -> id_bits 12, pk_bits 20 -> headroom 2^20.
+    big = np.uint32(1 << 21)
+
+    q = gen.batch(300)
+    # Half the rows carry packet counts beyond the known-lane headroom
+    # (pk_bits = 32 - id_bits; small_cfg slots = 2^12 -> 20-bit
+    # headroom), half stay tiny.
+    q[: len(q) // 2, F.PACKETS] = big
+    quanta = [q, q.copy(), q.copy()]  # passes 2-3: all descriptors known
+
+    eng_plain = SketchEngine(small_cfg(wire_flow_dict=False, **kw))
+    eng_plain.update_identities({0x0A000000 + i: i for i in range(1, 20)})
+    snap_a = _feed(eng_plain, quanta)
+
+    eng_dict = SketchEngine(small_cfg(**kw))
+    eng_dict.update_identities({0x0A000000 + i: i for i in range(1, 20)})
+    assert eng_dict._fd_pk_bits == 32 - eng_dict._fd_id_bits
+    assert int(big) >= (1 << eng_dict._fd_pk_bits)
+    m0 = get_metrics().wire_rows.labels(kind="known")._value.get()
+    snap_b = _feed(eng_dict, quanta)
+    known_rows = (
+        get_metrics().wire_rows.labels(kind="known")._value.get() - m0
+    )
+    # Small-packet repeats DID ride the known side...
+    assert known_rows > 0
+    # ...and the exact counters agree with the plain path despite the
+    # escalated rows.
+    for k in ("pod_forward", "pod_drop"):
+        np.testing.assert_array_equal(
+            np.asarray(snap_a[k]), np.asarray(snap_b[k]), err_msg=k
+        )
+    assert (
+        np.asarray(snap_a["totals"])[0] == np.asarray(snap_b["totals"])[0]
+    )
+
+
+def test_v3_latency_and_unstamped_rows_never_ride_known_path():
+    """The 8-byte known lane replaces per-row time with the flush base,
+    so rows where exact time matters must escalate: TSval/TSecr carriers
+    (apiserver RTT matcher) and unstamped rows (TS_REL=0 must round-trip
+    to ts 0, parallel/wire.py:17-23)."""
+    from retina_tpu.events.schema import F
+    from retina_tpu.metrics import get_metrics
+
+    eng = SketchEngine(small_cfg(data_aggregation_level="high"))
+    eng.compile()
+    gen = TrafficGen(n_flows=40, n_pods=16, seed=11)
+    q = gen.batch(200)
+    q[: len(q) // 3, F.TSVAL] = 12345  # RTT-relevant
+    third = len(q) // 3
+    q[third : 2 * third, F.TS_LO] = 0  # unstamped
+    q[third : 2 * third, F.TS_HI] = 0
+    known = get_metrics().wire_rows.labels(kind="known")
+    eng.step_records(q, now_s=5)
+    k0 = known._value.get()
+    eng.step_records(q.copy(), now_s=6)  # all descriptors now resident
+    k1 = known._value.get()
+    # Plain repeats rode the known side; the TSval + unstamped thirds
+    # must NOT have (they escalate to full rows every quantum).
+    expected_known_max = len(np.unique(q[2 * third :, : 16], axis=0))
+    assert 0 < k1 - k0 <= expected_known_max, (k0, k1)
+
+
 def test_dict_self_metrics_published():
     """Operators need the wire-savings evidence on /metrics: resident
     entries, generation, and new/known row counters."""
