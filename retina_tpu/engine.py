@@ -35,8 +35,8 @@ from retina_tpu.events.schema import F, NUM_FIELDS
 from retina_tpu.log import logger
 from retina_tpu.metrics import get_metrics
 from retina_tpu.models.identity import HostIdentityTable, IdentityMap
-from retina_tpu.models.pipeline import PipelineConfig, TelemetryPipeline
-from retina_tpu.parallel.combine import combine_records
+from retina_tpu.models.pipeline import PipelineConfig
+from retina_tpu.parallel.combine import combine_blocks
 from retina_tpu.parallel.flowdict import make_flow_dict
 from retina_tpu.parallel.partition import (
     ShardedBatch, _next_bucket, partition_events,
@@ -160,6 +160,10 @@ class SketchEngine:
         self._fd_id_bits = max(1, (cfg.flow_dict_slots - 1).bit_length())
         self._fd_pk_bits = 32 - self._fd_id_bits
         self._fd_lock = threading.Lock()
+        import os as _os
+
+        # Cached once: the trace flag is read on every dispatch.
+        self._feed_trace = _os.environ.get("RETINA_FEED_TRACE") == "1"
         self._desc_table: Any = None
         # Bumped ONLY by failure resyncs (not by capacity-overflow
         # generation clears, which keep the device table intact and are
@@ -744,6 +748,7 @@ class SketchEngine:
         FIFO-ordered so inserts land before gathers."""
         from retina_tpu.parallel.wire import batch_ts_base, pack_records
 
+        t_d0 = time.monotonic()
         with self._ident_lock:
             ident = self.ident
             fmap = self.filter_map
@@ -963,10 +968,19 @@ class SketchEngine:
                     self._inflight_busy -= 1
                 self._inflight.release()
 
+        t_d1 = time.monotonic()
         self._inflight.acquire()
         with self._busy_lock:
             self._inflight_busy += 1
         submit_on_device(safe_xfer_and_step)
+        if self._feed_trace:
+            self.log.info(
+                "dispatch trace: build %.0fms inflight-wait %.0fms "
+                "(%d new / %d known rows)",
+                (t_d1 - t_d0) * 1e3,
+                (time.monotonic() - t_d1) * 1e3,
+                int(nv_new.sum()), int(nv_known.sum()),
+            )
 
     def _dispatch_sharded(
         self, sb: "ShardedBatch", now_s: int, n_raw: int,
@@ -1351,29 +1365,63 @@ class SketchEngine:
         last_flush = time.monotonic()
         next_window = time.monotonic() + self.cfg.window_seconds
 
+        feed_trace = self._feed_trace
+        trace_acc = {"accum": 0.0, "combine": 0.0, "part": 0.0,
+                     "submit": 0.0, "n": 0, "ev": 0}
+        t_flush_end = time.monotonic()
+
         def flush():
-            nonlocal pending, n_pending, last_flush
-            if len(pending) == 1:
+            nonlocal pending, n_pending, last_flush, t_flush_end
+            t0 = time.monotonic()
+            n_raw = n_pending
+            if self.cfg.host_combine:
+                # Multi-block combine: the quantum's block list feeds
+                # the native combiner directly — no concat copy
+                # (parallel/combine.combine_blocks).
+                all_rec = combine_blocks(pending)
+                m.combine_ratio.set(n_raw / max(len(all_rec), 1))
+            elif len(pending) == 1:
                 all_rec = pending[0]  # skip the concat copy
             else:
                 all_rec = np.concatenate(pending, axis=0)
             pending = []
             n_pending = 0
             last_flush = time.monotonic()
-            n_raw = len(all_rec)
-            if self.cfg.host_combine:
-                all_rec = combine_records(all_rec)
-                m.combine_ratio.set(n_raw / max(len(all_rec), 1))
+            t1 = last_flush
             now_s = int(time.time())
+            t2 = t1
             for off in range(0, len(all_rec), coal):
                 chunk = all_rec[off : off + coal]
                 sb = partition_events(
                     chunk, self.n_devices, coal_per_dev,
                     min_bucket=self.cfg.transfer_min_bucket,
                 )
+                t2 = time.monotonic()
                 # raw-row accounting goes to the chunk that carries it;
                 # chunk boundaries are an implementation detail
                 submit(("step", sb, now_s, n_raw if off == 0 else 0))
+            if feed_trace:
+                t3 = time.monotonic()
+                trace_acc["accum"] += t0 - t_flush_end
+                trace_acc["combine"] += t1 - t0
+                trace_acc["part"] += t2 - t1
+                trace_acc["submit"] += t3 - t2
+                trace_acc["n"] += 1
+                trace_acc["ev"] += n_raw
+                t_flush_end = t3
+                if trace_acc["n"] % 8 == 0:
+                    per = {k: trace_acc[k] / trace_acc["n"]
+                           for k in ("accum", "combine", "part",
+                                     "submit")}
+                    self.log.info(
+                        "feed trace: %d flushes, %.2fM ev/flush, "
+                        "accum %.0fms combine %.0fms part %.0fms "
+                        "submit %.0fms",
+                        trace_acc["n"],
+                        trace_acc["ev"] / trace_acc["n"] / 1e6,
+                        per["accum"] * 1e3, per["combine"] * 1e3,
+                        per["part"] * 1e3, per["submit"] * 1e3,
+                    )
 
         try:
             while not stop.is_set():

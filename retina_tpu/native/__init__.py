@@ -97,6 +97,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
             ctypes.c_uint,
         ]
+        lib.rt_combine_multi.restype = ctypes.c_long
+        lib.rt_combine_multi.argtypes = [
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32)),
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+        ]
         lib.rt_flowdict_new.restype = ctypes.c_void_p
         lib.rt_flowdict_new.argtypes = [ctypes.c_uint32]
         lib.rt_flowdict_free.restype = None
@@ -210,6 +216,13 @@ def _default_combine_threads() -> int:
 _combine_threads = _default_combine_threads()
 
 
+def get_combine_threads() -> int:
+    """Current combiner thread count (combine_blocks routes multi-core
+    quanta through the MT concat path instead of the single-thread
+    multi-block pass)."""
+    return _combine_threads
+
+
 def set_combine_threads(n: int) -> None:
     """Engine/config hook (host_combine_threads). PROCESS-WIDE: the
     combiner is shared library state, so with several engines in one
@@ -249,6 +262,45 @@ def combine_native(records: np.ndarray) -> Optional[np.ndarray]:
     _combine_hint_groups = int(g)
     if g == n:
         return records
+    return out[:g]
+
+
+def combine_native_blocks(
+    blocks: list,
+) -> Optional[np.ndarray]:
+    """C++ multi-block combine (combine.cpp rt_combine_multi): one pass
+    over a LIST of (n_i, 16) u32 blocks, skipping the concatenation
+    copy the single-array path needs (~40% of the combine stage at
+    production quanta). Output is bit-identical to
+    ``combine_native(np.concatenate(blocks))``. Returns None when the
+    library is unavailable or any block isn't a plain (N, 16) u32
+    array — callers fall back to concat + combine."""
+    global _combine_hint_groups
+    lib = get_lib()
+    if lib is None or not blocks:
+        return None
+    total = 0
+    for b in blocks:
+        if (b.ndim != 2 or b.shape[1] != 16 or b.dtype != np.uint32
+                or not b.flags.c_contiguous):
+            return None
+        total += len(b)
+    if total == 0:
+        return blocks[0][:0]
+    ptrs = (ctypes.POINTER(ctypes.c_uint32) * len(blocks))(
+        *[b.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+          for b in blocks]
+    )
+    ns = (ctypes.c_size_t * len(blocks))(*[len(b) for b in blocks])
+    out = np.empty((total, 16), np.uint32)
+    g = lib.rt_combine_multi(
+        ptrs, ns, len(blocks),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        4 * _combine_hint_groups,
+    )
+    if g < 0:
+        return None
+    _combine_hint_groups = int(g)
     return out[:g]
 
 

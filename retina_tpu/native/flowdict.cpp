@@ -28,10 +28,14 @@ constexpr int NUM_FIELDS = 16;
 constexpr int KEY_COLS[12] = {2, 3, 4, 5, 8, 9, 10, 11, 12, 13, 14, 15};
 constexpr int KEY_LEN = 12;
 
+// The extracted key is contiguous: hash it as six u64 words (half the
+// mix rounds of the per-column loop; this probe sits on the per-quantum
+// feed path).
 inline uint64_t hash_desc(const uint32_t* key) {
-  uint64_t h = 0x9E3779B97F4A7C15ull;
-  for (int i = 0; i < KEY_LEN; i++) {
-    h ^= key[i];
+  uint64_t h = 0x9E3779B97F4A7C15ull, v;
+  for (int i = 0; i < KEY_LEN / 2; i++) {
+    memcpy(&v, key + 2 * i, 8);
+    h ^= v;
     h *= 0xFF51AFD7ED558CCDull;
     h ^= h >> 33;
   }

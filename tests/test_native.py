@@ -314,6 +314,45 @@ def test_pack_native_matches_numpy_reference():
     assert out_e.shape == (0, 12) and base_e == 0
 
 
+def test_combine_blocks_bit_identical_to_concat():
+    """rt_combine_multi consumes the flush's block list directly (no
+    concat copy); its output must be BIT-identical — same rows, same
+    first-appearance order — to combining the concatenation."""
+    from retina_tpu.events.synthetic import TrafficGen
+    from retina_tpu.parallel.combine import combine_blocks, combine_records
+
+    gen = TrafficGen(n_flows=500, n_pods=32, seed=21)
+    # Ragged block sizes, including empty and single-row blocks.
+    blocks = [
+        gen.batch(max(n, 1))[:n] for n in (512, 1, 730, 0, 256, 8192, 3)
+    ]
+    ref = combine_records(np.concatenate(blocks))
+    out = combine_blocks(blocks)
+    np.testing.assert_array_equal(ref, out)
+    # Single-block and all-empty edge cases.
+    np.testing.assert_array_equal(
+        combine_blocks([blocks[0]]), combine_records(blocks[0])
+    )
+    empty = gen.batch(1)[:0]
+    assert len(combine_blocks([empty, empty.copy()])) == 0
+
+    # Multi-core regime: combine_blocks must route through the MT
+    # concat path (where chunk-major order makes the single-thread
+    # multi-block pass non-comparable) — the contract holds because it
+    # literally IS concat + combine_records there.
+    from retina_tpu.native import get_combine_threads, set_combine_threads
+
+    prev = get_combine_threads()
+    try:
+        set_combine_threads(4)
+        big = [gen.batch(1 << 14) for _ in range(6)]  # >= MT threshold
+        np.testing.assert_array_equal(
+            combine_blocks(big), combine_records(np.concatenate(big))
+        )
+    finally:
+        set_combine_threads(prev)
+
+
 def test_combine_hint_grow_path_identical():
     """rt_combine_hint must return identical groups for any hint —
     including one that undershoots so far the table doubles repeatedly
