@@ -16,9 +16,13 @@ Hardened per round-1 verdict:
   carrying an "error" field so the driver records a diagnosis instead of
   an empty file.
 
-Prints ONE JSON line:
-  {"metric": "flow_events_per_sec_per_chip", "value": N, "unit": "events/s",
-   "vs_baseline": value / 10e6}
+Prints ONE JSON line. The default run's headline is the END-TO-END
+system rate (the north-star claim):
+  {"metric": "flow_events_per_sec_e2e", "value": N, "unit": "events/s",
+   "vs_baseline": value / 10e6,
+   "extra": {"e2e": {...}, "device_step": {...}}}
+with the device-resident step rate in extra.device_step. --no-e2e emits
+the device-step metric (flow_events_per_sec_per_chip) as before.
 vs_baseline is measured against the north-star target of 10M
 flow-events/sec/node (BASELINE.md; the reference publishes no absolute
 numbers, so the target is the baseline).
@@ -251,35 +255,56 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     from retina_tpu.metrics import get_metrics
 
     enable_compilation_cache(DEFAULT_CACHE_DIR)
-    dur = duration_s if duration_s is not None else (8.0 if smoke else 40.0)
+    # Per-window duration: three windows run back to back (median
+    # reported), so each window is shorter than the old single one.
+    dur = duration_s if duration_s is not None else (5.0 if smoke else 15.0)
     warmup = 2.0 if smoke else 5.0
 
     link_mbs = _measure_link_bandwidth()
     log(f"e2e: link bandwidth probe {link_mbs:.0f} MB/s")
 
-    # Host-path capability probe (no device): combine + pack + partition
-    # of one flush quantum — the ceiling the host CPU side imposes when
-    # the link stops being the bottleneck (production PCIe).
+    # Host-path capability probe (no device): the REAL per-quantum feed
+    # work — combine + partition + flow-dict assign + v3 wire build —
+    # the ceiling the host CPU side imposes when the link stops being
+    # the bottleneck (production PCIe). Median of 3 quanta; the steady
+    # state (all descriptors known) is what it measures.
+    from retina_tpu.events.schema import F
     from retina_tpu.events.synthetic import TrafficGen
-    from retina_tpu.parallel.combine import combine_records
+    from retina_tpu.parallel.combine import combine_blocks
+    from retina_tpu.parallel.flowdict import make_flow_dict
     from retina_tpu.parallel.partition import partition_events
-    from retina_tpu.parallel.wire import pack_records
 
     probe_gen = TrafficGen(
         n_flows=50_000 if smoke else 1_000_000,
         n_pods=256 if smoke else 2048, seed=7,
     )
-    quantum = np.concatenate(
-        [probe_gen.batch(1 << 17) for _ in range(2 if smoke else 16)]
-    )
-    t0 = time.perf_counter()
-    comb = combine_records(quantum)
-    pack_records(
-        partition_events(comb, 1, 1 << 19, min_bucket=1 << 12).records
-    )
-    host_path_rate = len(quantum) / (time.perf_counter() - t0)
-    log(f"e2e: host-path probe {host_path_rate / 1e6:.1f}M ev/s "
-        f"(combine ratio {len(quantum) / len(comb):.1f})")
+    blocks = [
+        probe_gen.batch(1 << 13) for _ in range(32 if smoke else 256)
+    ]
+    n_quantum = sum(len(b) for b in blocks)
+    fdict = make_flow_dict(1 << 18)
+    id_bits = np.uint32(18)
+    comb0 = combine_blocks(blocks)
+    fdict.lookup_or_assign(
+        partition_events(comb0, 1, 1 << 19, min_bucket=1 << 12)
+        .records[0]
+    )  # warm pass: descriptors resident, like a running agent
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        comb = combine_blocks(blocks)
+        sb = partition_events(comb, 1, 1 << 19, min_bucket=1 << 12)
+        rows = sb.records[0, : int(sb.n_valid[0])]
+        ids, is_new = fdict.lookup_or_assign(rows)
+        rk = rows[~is_new]
+        known_wire = np.empty((len(rk), 2), np.uint32)
+        known_wire[:, 0] = ids[~is_new] | (rk[:, F.PACKETS] << id_bits)
+        known_wire[:, 1] = rk[:, F.BYTES]
+        rates.append(n_quantum / (time.perf_counter() - t0))
+    host_path_rate = sorted(rates)[1]
+    log(f"e2e: host-path probe {host_path_rate / 1e6:.1f}M ev/s median "
+        f"of {[round(r / 1e6, 1) for r in rates]} "
+        f"(combine ratio {n_quantum / len(comb0):.1f})")
 
     cfg = Config()
     cfg.api_server_addr = "127.0.0.1:0"
@@ -289,6 +314,21 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     cfg.synthetic_flows = 50_000 if smoke else 1_000_000
     cfg.synthetic_pregen = 16 if smoke else 256  # 131k / 2.1M event ring
     cfg.batch_capacity = 1 << (14 if smoke else 19)
+    if not smoke:
+        # The host feed is fixed-cost-per-flush bound on a 1-core agent
+        # box: bigger quanta amortize combine/assign/dispatch fixed
+        # costs, and one coalesced transfer keeps the link busy
+        # back-to-back. (A 2^21 step capacity was tried and regressed:
+        # it doubles every ingest key's program size, turning the
+        # bucket-grid warm into tens of minutes of tunnel compiles.)
+        cfg.flush_max_events = 1 << 22
+        cfg.feed_coalesce_windows = 8
+        # Full quanta before the age bound cuts them (0.4s default was
+        # age-flushing at ~2.9M of the 4.2M quantum), and a deeper
+        # in-flight window so multi-second tunnel stall episodes drain
+        # queued transfers instead of stalling the feed.
+        cfg.flush_max_age_s = 0.8
+        cfg.feed_pipeline_depth = 6
     cfg.bypass_lookup_ip_of_interest = True
     n_pods = 256 if smoke else 2048
 
@@ -345,6 +385,18 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
             )
         time.sleep(0.5)
     log(f"e2e: first traffic after {time.monotonic() - tstart:.0f}s")
+    # Steady state starts once the background bucket-grid warm is done:
+    # its cold compiles serialize on the device proxy and would turn the
+    # measure windows into compile-stall weather (the agent is READY and
+    # serving throughout — this wait is about what the windows measure,
+    # not about boot latency, which is reported above).
+    t_warm = time.monotonic()
+    if not eng.bucket_warm_done.wait(300):
+        log("e2e: WARNING bucket grid warm not done after 300s; "
+            "measuring anyway")
+    else:
+        log(f"e2e: bucket grid warm complete "
+            f"{time.monotonic() - t_warm:.0f}s after first traffic")
     time.sleep(warmup)
 
     def measure_window() -> dict:
@@ -367,27 +419,29 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
             "lat": lat,
         }
 
-    win = measure_window()
-    windows = [win]
-    # The tunnel stalls in episodes (measured 0.26M-5M ev/s for one
-    # build as the link swung): when the window underperforms what its
-    # own wire efficiency says the BOOT-TIME link probe sustains,
-    # measure once more in the same boot and report the better window —
-    # both are attached. The probe is never repeated (the live agent
-    # owns the runtime client; see the log line below), so a link that
-    # degraded after boot can fire this spuriously: that costs one
-    # extra window, never a wrong number.
-    wire_bpe_w = win["wire_bytes"] / max(win["events"], 1)
-    expected = (link_mbs * 1e6) / max(wire_bpe_w, 1e-9)
-    if win["rate"] < 0.6 * min(expected, host_path_rate):
-        log(f"e2e: window at {win['rate'] / 1e6:.2f}M ev/s vs "
-            f"{expected / 1e6:.1f}M expected from the link probe — "
-            "remeasuring once (tunnel episode). No link re-probe: the "
-            "agent owns the runtime client now (single-thread rule).")
-        win2 = measure_window()
-        windows.append(win2)
-        if win2["rate"] > win["rate"]:
-            win = win2
+    def _proxy_seconds() -> float:
+        try:
+            return (m.transfer_seconds._sum.get()
+                    + m.device_step_seconds._sum.get())
+        except Exception:
+            return 0.0
+
+    # Median of three windows: the tunnel stalls in episodes (measured
+    # 0.26M-5M ev/s for one build as the link swung), so a single
+    # window is weather, not a measurement. The reported rate, scrape
+    # latencies, and wire efficiency all come from the MEDIAN-rate
+    # window; every window's rate is attached.
+    proxy_s0 = _proxy_seconds()
+    t_win0 = time.monotonic()
+    windows = [measure_window() for _ in range(3)]
+    # Steady-state proxy occupancy over EXACTLY the measured span (the
+    # whole-run sums would fold boot compiles and warm waits in).
+    proxy_share = (_proxy_seconds() - proxy_s0) / max(
+        time.monotonic() - t_win0, 1e-9
+    )
+    log("e2e: windows "
+        + ", ".join(f"{w['rate'] / 1e6:.2f}M" for w in windows))
+    win = sorted(windows, key=lambda w: w["rate"])[len(windows) // 2]
     rate = win["rate"]
     lat = win["lat"]
     ev_delta = win["events"]
@@ -396,6 +450,21 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     stop.set()
     t.join(60)
 
+    # Per-dispatch self-diagnostics: where a slow window's time went.
+    try:
+        xf_s = m.transfer_seconds._sum.get()
+        xf_n = sum(b.get() for b in m.transfer_seconds._buckets)
+        st_s = m.device_step_seconds._sum.get()
+        log(
+            f"e2e: diag transfers={xf_n:.0f} "
+            f"avg_transfer={xf_s / max(xf_n, 1) * 1e3:.1f}ms "
+            f"step_sum={st_s:.1f}s steps={eng._steps} "
+            f"proxy_share={proxy_share:.2f} "
+            f"fill={m.device_batch_fill._value.get():.3f} "
+            f"events_in={eng._events_in}"
+        )
+    except Exception:
+        pass
     lat.sort()
     p50 = lat[len(lat) // 2]
     p99 = lat[min(int(len(lat) * 0.99), len(lat) - 1)]
@@ -405,13 +474,16 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     assert "networkobservability_forward_count" in body
     if wire_bpe * rate / 1e6 >= 0.5 * link_mbs:
         bottleneck = "host->device link bandwidth"
-    elif rate < 0.5 * host_path_rate:
-        # Wire is underfed AND the host side can go much faster: the
-        # remaining cost is per-dispatch round-trip latency to the
-        # device runtime (tunnel RTT on this harness).
+    elif proxy_share >= 0.5:
+        # The proxy thread spends most of its wall clock inside device
+        # calls: per-dispatch round trips gate the system (tunnel RTT
+        # on this harness).
         bottleneck = "device dispatch round-trip latency"
     else:
-        bottleneck = "host feed path"
+        # Wire underfed AND the proxy mostly idle: the stage probes run
+        # faster in isolation than the full agent sustains because
+        # source+feed+combine+assign+server all share the host cores.
+        bottleneck = "host feed path (core contention)"
     res = {
         "events_per_sec": round(rate),
         "scrape_p50_ms": round(p50 * 1e3, 1),
@@ -538,23 +610,34 @@ def main() -> None:
             # after the device phase moved 256 MiB through the client),
             # while each phase alone is healthy. Sequential processes
             # also respect the one-JAX-process rule.
-            out = _run_device_phase_subprocess(args.smoke)
-            if out is None:
+            device = _run_device_phase_subprocess(args.smoke)
+            if device is None:
                 # Fallback: old in-process path. The e2e number below
                 # is then suspect (shared runtime client degraded it to
                 # ~0.1% in testing) — flag it so the driver can tell.
-                out = run(args.smoke)
-                out.setdefault("extra", {})["device_phase_in_process"] = True
-            # Default run carries the system number alongside the
-            # device-step number so one JSON line captures both.
-            # Slightly shorter window than standalone --e2e keeps
-            # the combined run's wall clock bounded for the driver.
+                device = run(args.smoke)
+                device.setdefault("extra", {})[
+                    "device_phase_in_process"] = True
+            # HEADLINE = the end-to-end system number (the north-star
+            # claim, BASELINE.md); the device-step rate rides along in
+            # extra.device_step. Shorter windows than standalone --e2e
+            # keep the combined run's wall clock bounded for the driver.
             try:
-                out.setdefault("extra", {})["e2e"] = run_e2e(
-                    args.smoke, duration_s=8.0 if args.smoke else 25.0
+                e2e = run_e2e(
+                    args.smoke, duration_s=4.0 if args.smoke else 12.0
                 )
+                out = {
+                    "metric": "flow_events_per_sec_e2e",
+                    "value": e2e["events_per_sec"],
+                    "unit": "events/s",
+                    "vs_baseline": round(
+                        e2e["events_per_sec"] / 10_000_000, 4
+                    ),
+                    "extra": {"e2e": e2e, "device_step": device},
+                }
             except Exception as e:  # noqa: BLE001
                 log("e2e phase FAILED:\n" + traceback.format_exc())
+                out = device  # device-step headline as the fallback
                 out.setdefault("extra", {})["e2e"] = {
                     "error": f"{type(e).__name__}: {e}".splitlines()[0][:400]
                 }
