@@ -26,9 +26,12 @@ distributed:
 	RETINA_DISTRIBUTED_TESTS=1 \
 	    python -m pytest tests/test_distributed_two_process.py -q
 
-# Critical-error gate (matches .github/workflows/lint.yaml).
+# Critical-error gate (matches .github/workflows/lint.yaml). The TPU
+# image has no ruff/mypy; tools/lint.py is the offline mirror of the
+# high-precision ruff rules (CI runs the real ones).
 lint:
-	python -m compileall -q retina_tpu tests bench.py __graft_entry__.py
+	python -m compileall -q retina_tpu tests tools bench.py __graft_entry__.py
+	python tools/lint.py
 
 clean:
 	$(MAKE) -C retina_tpu/native clean

@@ -16,12 +16,10 @@ wraps tcpdump with duration/size limits; Windows netsh variant
 
 from __future__ import annotations
 
-import os
 import shutil
 import subprocess
 import threading
 import time
-from typing import Optional
 
 import numpy as np
 

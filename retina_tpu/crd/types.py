@@ -15,7 +15,7 @@ Validation mirrors crd/api/v1alpha1/validations/.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any
 
 import yaml
 

@@ -18,7 +18,6 @@ per-node agents account their own loss).
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional
 
 import grpc

@@ -12,8 +12,6 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-from prometheus_client import Counter, Gauge, Histogram
-
 from retina_tpu.exporter import Exporter, get_exporter
 from retina_tpu.log import logger
 from retina_tpu.utils import metric_names as mn

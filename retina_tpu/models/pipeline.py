@@ -21,12 +21,9 @@ Cardinality design (the reference's modes, docs/03-Metrics/modes/modes.md):
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
-from typing import Any
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from retina_tpu.events.schema import (
     F,
@@ -36,13 +33,10 @@ from retina_tpu.events.schema import (
     VERDICT_DROPPED,
     VERDICT_FORWARDED,
     DIR_INGRESS,
-    DIR_EGRESS,
     PROTO_TCP,
-    PROTO_UDP,
 )
 from retina_tpu.models.identity import IdentityMap
 from retina_tpu.ops.conntrack import ConntrackTable
-from retina_tpu.ops.countmin import CountMinSketch
 from retina_tpu.ops.entropy import AnomalyEWMA, EntropyWindow
 from retina_tpu.ops.hyperloglog import HyperLogLog
 from retina_tpu.ops.topk import HeavyHitterSketch

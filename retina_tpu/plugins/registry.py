@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Callable, Type
 
 from retina_tpu.config import Config
-from retina_tpu.plugins import api
+from retina_tpu.plugins import api  # noqa: F401 — quoted annotations below
 
 PluginCtor = Callable[[Config], "api.Plugin"]
 

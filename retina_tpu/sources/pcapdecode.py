@@ -31,11 +31,9 @@ from retina_tpu.events.schema import (
     F,
     NUM_FIELDS,
     OP_FROM_NETWORK,
-    OP_TO_NETWORK,
     PROTO_TCP,
     PROTO_UDP,
     VERDICT_FORWARDED,
-    pack_ports,
 )
 
 PCAP_MAGIC_US = 0xA1B2C3D4

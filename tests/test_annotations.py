@@ -4,7 +4,6 @@ retina.sh=observe or living in an annotated namespace, fed by the
 namespace watch (reference namespace_controller.go + podAnnotated,
 metrics_module.go:575-595)."""
 
-import pytest
 
 from retina_tpu.common import RetinaEndpoint
 from retina_tpu.config import Config

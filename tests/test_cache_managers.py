@@ -11,11 +11,9 @@ from retina_tpu.common import RetinaEndpoint, RetinaSvc, TOPIC_ENDPOINTS
 from retina_tpu.config import Config
 from retina_tpu.controllers.cache import Cache
 from retina_tpu.events.schema import ip_to_u32
-from retina_tpu.exporter import reset_for_tests as reset_exporter
 from retina_tpu.managers.filtermanager import FilterManager
 from retina_tpu.managers.pluginmanager import PluginManager
 from retina_tpu.managers.watchermanager import WatcherManager
-from retina_tpu.metrics import reset_for_tests as reset_metrics
 from retina_tpu.plugins.mockplugin import MockPlugin
 from retina_tpu.pubsub import PubSub
 from retina_tpu.watchers.apiserver import ApiServerWatcher

@@ -6,7 +6,6 @@ without a cluster."""
 
 import os
 import tarfile
-import time
 
 import numpy as np
 import pytest
@@ -14,7 +13,6 @@ import pytest
 from retina_tpu.capture.manager import CaptureManager
 from retina_tpu.capture.outputs import (
     BlobOutput,
-    HostPathOutput,
     S3Output,
     outputs_from_spec,
 )

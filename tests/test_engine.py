@@ -18,7 +18,6 @@ from retina_tpu.events.schema import (
     NUM_FIELDS,
     OP_FROM_NETWORK,
     PROTO_TCP,
-    VERDICT_DROPPED,
     VERDICT_FORWARDED,
 )
 from retina_tpu.events.synthetic import POD_NET, TrafficGen

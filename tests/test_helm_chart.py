@@ -16,7 +16,6 @@ from retina_tpu.config import Config
 from retina_tpu.utils.helmlite import (
     HelmliteError,
     render,
-    render_chart,
     render_chart_docs,
 )
 

@@ -3,12 +3,10 @@ agent fan-out, observer ring follow/loss semantics, the gRPC relay
 end-to-end (stream flows over a real localhost channel) — covering the
 reference's pkg/hubble + pkg/monitoragent surface."""
 
-import queue
 import threading
 import time
 
 import numpy as np
-import pytest
 
 from retina_tpu.common import RetinaEndpoint
 from retina_tpu.controllers.cache import Cache

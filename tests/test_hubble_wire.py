@@ -10,8 +10,6 @@ upstream field numbering is checked on the wire, not via our own classes.
 """
 
 import subprocess
-import threading
-import time
 
 import grpc
 import numpy as np

@@ -14,8 +14,7 @@ import pytest
 import retina_tpu.plugins  # noqa: F401  (trigger self-registration)
 from retina_tpu.config import Config
 from retina_tpu.events.schema import EV_DNS_REQ, EV_DNS_RESP, F, NUM_FIELDS
-from retina_tpu.exporter import reset_for_tests as reset_exporter
-from retina_tpu.metrics import get_metrics, reset_for_tests as reset_metrics
+from retina_tpu.metrics import get_metrics
 from retina_tpu.plugins import registry
 from retina_tpu.plugins.api import QueueSink
 from retina_tpu.plugins.dns import DnsPlugin

@@ -4,7 +4,6 @@ local diagnostic shell preps env without exec."""
 
 import json
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest

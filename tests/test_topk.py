@@ -3,7 +3,7 @@
 import numpy as np
 import jax.numpy as jnp
 
-from retina_tpu.ops.topk import HeavyHitterSketch, TopKTable
+from retina_tpu.ops.topk import HeavyHitterSketch
 
 
 def _zipf_stream(n, n_keys, seed=0, alpha=1.3):

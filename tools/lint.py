@@ -1,0 +1,171 @@
+#!/usr/bin/env python3
+"""Offline static analysis for retina_tpu (no third-party linters in
+the TPU image, so this provides the high-precision subset of ruff's
+F/E9/B rules locally; CI additionally runs real ruff+mypy where pip is
+available — .github/workflows/lint.yaml).
+
+Checks (all precise, no style opinions):
+  F401  module-level import never used (skipped in __init__.py
+        re-export surfaces and for names listed in __all__)
+  E722  bare `except:`
+  B006  mutable default argument (list/dict/set literal)
+  F541  f-string without placeholders
+  E711  comparison to None with ==/!=
+  F601  duplicate dict literal key
+  B011  assert on a non-empty tuple (always true)
+  F811  duplicate top-level def/class name
+
+`# noqa` (with or without a code) on the flagged line suppresses it.
+Exit code 1 if any finding. Usage: python tools/lint.py [paths...]
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+
+def _names_loaded(tree: ast.AST) -> set[str]:
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # a.b.c -> root name a (covers `import a.b` usage)
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+    return used
+
+
+def _all_exports(tree: ast.Module) -> set[str]:
+    out: set[str] = set()
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str):
+                    out.add(elt.value)
+    return out
+
+
+def check_file(path: Path) -> list[tuple[int, str, str]]:
+    src = path.read_text()
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [(e.lineno or 0, "E999", f"syntax error: {e.msg}")]
+
+    finds: list[tuple[int, str, str]] = []
+
+    def add(lineno: int, code: str, msg: str) -> None:
+        if 0 < lineno <= len(lines) and "noqa" in lines[lineno - 1]:
+            return
+        finds.append((lineno, code, msg))
+
+    used = _names_loaded(tree)
+    exported = _all_exports(tree)
+    is_init = path.name == "__init__.py"
+
+    # F401 — only module-level imports; conftest/test fixtures excluded
+    # by the caller's path selection.
+    if not is_init:
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    if name not in used and name not in exported:
+                        add(node.lineno, "F401",
+                            f"`import {a.name}` unused")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    name = a.asname or a.name
+                    if name not in used and name not in exported:
+                        add(node.lineno, "F401",
+                            f"`from {node.module} import {a.name}` unused")
+
+    seen_top: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if node.name in seen_top:
+                add(node.lineno, "F811",
+                    f"`{node.name}` redefines line {seen_top[node.name]}")
+            seen_top[node.name] = node.lineno
+
+    # Format specs (f"{x:.1f}") parse as JoinedStr children of
+    # FormattedValue — not user f-strings; exclude them from F541.
+    spec_ids = {
+        id(n.format_spec) for n in ast.walk(tree)
+        if isinstance(n, ast.FormattedValue) and n.format_spec is not None
+    }
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            add(node.lineno, "E722", "bare `except:`")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in (*node.args.defaults, *node.args.kw_defaults):
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    add(d.lineno, "B006", "mutable default argument")
+        elif isinstance(node, ast.JoinedStr):
+            if id(node) not in spec_ids and not any(
+                    isinstance(v, ast.FormattedValue)
+                    for v in node.values):
+                add(node.lineno, "F541", "f-string without placeholders")
+        elif isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if (isinstance(op, (ast.Eq, ast.NotEq))
+                        and isinstance(comp, ast.Constant)
+                        and comp.value is None):
+                    add(node.lineno, "E711",
+                        "comparison to None (use `is`/`is not`)")
+        elif isinstance(node, ast.Dict):
+            keys = [
+                k.value for k in node.keys
+                if isinstance(k, ast.Constant)
+                and isinstance(k.value, (str, int))
+            ]
+            dupes = {k for k in keys if keys.count(k) > 1}
+            if dupes:
+                add(node.lineno, "F601",
+                    f"duplicate dict key(s): {sorted(map(str, dupes))}")
+        elif isinstance(node, ast.Assert):
+            if isinstance(node.test, ast.Tuple) and node.test.elts:
+                add(node.lineno, "B011",
+                    "assert on a tuple is always true")
+    return finds
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(p) for p in (argv or ["retina_tpu", "tests", "tools",
+                                        "bench.py", "__graft_entry__.py"])]
+    files: list[Path] = []
+    for r in roots:
+        if r.is_dir():
+            files += sorted(r.rglob("*.py"))
+        elif r.suffix == ".py":
+            files.append(r)
+    n = 0
+    for f in files:
+        if "__pycache__" in f.parts:
+            continue
+        for lineno, code, msg in check_file(f):
+            print(f"{f}:{lineno}: {code} {msg}")
+            n += 1
+    print(f"lint: {len(files)} files, {n} finding(s)")
+    return 1 if n else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
