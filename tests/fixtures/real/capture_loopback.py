@@ -1,0 +1,87 @@
+#!/usr/bin/env python3
+"""Fixture generator: capture REAL kernel-built packets off loopback.
+
+Provenance tool for ``loopback_real.pcap`` (see README.md in this
+directory). Opens an AF_PACKET socket on ``lo``, sends a handful of
+UDP datagrams and one TCP connect through the REAL Linux network stack
+(so every Ethernet/IPv4/UDP/TCP header byte is kernel-built, not
+assembled by this repo's encoders), and writes the captured frames as a
+nanosecond-resolution pcap.
+
+Run as root on any Linux host:  python capture_loopback.py
+"""
+import socket
+import struct
+import threading
+import time
+
+OUT = "loopback_real.pcap"
+UDP_PORT, TCP_PORT = 41999, 42001
+PAYLOADS = [b"retina-real-fixture-%d" % i for i in range(5)]
+
+
+def main() -> None:
+    cap = socket.socket(
+        socket.AF_PACKET, socket.SOCK_RAW, socket.htons(0x0003)
+    )
+    cap.bind(("lo", 0))
+    cap.settimeout(0.2)
+
+    # UDP listener + TCP acceptor so the kernel completes both flows.
+    usrv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    usrv.bind(("127.0.0.1", UDP_PORT))
+    tsrv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    tsrv.bind(("127.0.0.1", TCP_PORT))
+    tsrv.listen(1)
+    threading.Thread(
+        target=lambda: tsrv.accept()[0].recv(64), daemon=True
+    ).start()
+
+    time.sleep(0.1)
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    for p in PAYLOADS:
+        tx.sendto(p, ("127.0.0.1", UDP_PORT))
+    tc = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    tc.connect(("127.0.0.1", TCP_PORT))
+    tc.send(b"retina-tcp-fixture")
+    tc.close()
+    time.sleep(0.2)
+
+    def ours(fr: bytes) -> bool:
+        """Keep only the fixture flows' frames (ports 41999/42001):
+        loopback carries unrelated host traffic that must not land in a
+        committed fixture."""
+        if len(fr) < 38 or fr[12:14] != b"\x08\x00":
+            return False
+        ihl = (fr[14] & 0x0F) * 4
+        proto = fr[14 + 9]
+        if proto not in (6, 17):
+            return False
+        sport, dport = struct.unpack_from(">HH", fr, 14 + ihl)
+        return {sport, dport} & {UDP_PORT, TCP_PORT} != set()
+
+    frames = []
+    deadline = time.monotonic() + 1.0
+    while time.monotonic() < deadline:
+        try:
+            fr = cap.recv(65535)
+        except socket.timeout:
+            break
+        if ours(fr):
+            frames.append(fr)
+    with open(OUT, "wb") as f:
+        f.write(struct.pack(
+            "<IHHiIII", 0xA1B23C4D, 2, 4, 0, 0, 65535, 1
+        ))
+        ts = 1_700_000_000_000_000_000
+        for fr in frames:
+            f.write(struct.pack(
+                "<IIII", ts // 10**9, ts % 10**9, len(fr), len(fr)
+            ))
+            f.write(fr)
+            ts += 1000
+    print(f"wrote {len(frames)} kernel-built frames to {OUT}")
+
+
+if __name__ == "__main__":
+    main()
