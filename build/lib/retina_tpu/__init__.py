@@ -1,0 +1,30 @@
+"""retina_tpu — a TPU-native network observability framework.
+
+A ground-up re-design of the capabilities of Retina (reference:
+/root/reference, a Kubernetes network observability platform) for TPU
+hardware. The reference's per-node data plane is eBPF C programs feeding a
+Go agent that hash-aggregates flow events on CPU; here the data plane is a
+host-side event firehose batched into fixed-shape uint32 tensor columns and
+aggregated on-device by jit-compiled sketch kernels (Count-Min, HyperLogLog,
+streaming entropy, heavy-hitter candidates) that merge across chips with XLA
+collectives over ICI.
+
+Package map (reference layer in parentheses, see SURVEY.md §1):
+
+- ``events``   event record schema + sources (eBPF C programs + perf rings, L1)
+- ``ops``      device hash + sketch kernels (kernel-side per-CPU map aggregation)
+- ``models``   detector/aggregator models over sketches (pkg/module/metrics, L3)
+- ``parallel`` mesh, shardings, collective merges (Prometheus-pull / Hubble relay
+               cross-node aggregation, §2.6)
+- ``enrich``   identity cache + device join (pkg/enricher + pkg/controllers/cache)
+- ``plugins``  plugin registry + plugins (pkg/plugin, L2)
+- ``runtime``  managers, config, pubsub, server, telemetry (pkg/managers, L4/L0)
+- ``exporter`` Prometheus registries + exposition (pkg/exporter + pkg/metrics)
+- ``capture``  on-demand capture orchestration (pkg/capture, L3/L6)
+- ``export``   flow export / service-graph relay (pkg/hubble)
+- ``orchestration`` operator-style reconcilers over an in-memory API (operator/, L6)
+- ``cli``      command-line interface (cli/ kubectl-retina, L7)
+- ``native``   C++ ingest path: pcap parse + SPSC ring (pkg/plugin/*/_cprog, L1)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,7 @@
+"""``python -m retina_tpu`` → the retina-tpu CLI."""
+
+import sys
+
+from retina_tpu.cli import main
+
+sys.exit(main())

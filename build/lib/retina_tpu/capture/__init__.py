@@ -1,0 +1,17 @@
+"""Distributed packet-capture subsystem (reference pkg/capture).
+
+- translator: Capture spec → per-node capture jobs + tcpdump filter
+  synthesis (crd_to_job.go).
+- manager: node-side capture execution + metadata + tarball
+  (capture_manager.go).
+- providers: tcpdump subprocess / AF_PACKET socket / event-stream replay
+  (provider/network_capture_unix.go).
+- outputs: hostPath / PVC-path / blob / S3 sinks (outputlocation/).
+"""
+
+from retina_tpu.capture.manager import CaptureManager
+from retina_tpu.capture.translator import (
+    CaptureJob,
+    synthesize_filter,
+    translate_capture_to_jobs,
+)

@@ -1,0 +1,102 @@
+"""Node-side capture manager.
+
+Reference analog: pkg/capture/capture_manager.go:29-120 — the binary run
+inside each capture Job: set up the provider, capture packets, collect
+network metadata (ip/iptables/conntrack dumps, :73-77), tar.gz everything,
+and ship it to every enabled output location. The same flow here, executed
+by the operator's local job runner (retina_tpu/operator) or directly by
+the CLI.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import subprocess
+import tarfile
+import tempfile
+
+from retina_tpu.capture.outputs import outputs_from_spec
+from retina_tpu.capture.providers import best_provider
+from retina_tpu.capture.translator import CaptureJob
+from retina_tpu.log import logger
+
+_log = logger("capture.manager")
+
+# Metadata commands (capture_manager.go CollectMetadata :73-77); each is
+# best-effort — absent tools just produce an error note in the file.
+_METADATA_CMDS = {
+    "ip-addr.txt": ["ip", "addr"],
+    "ip-route.txt": ["ip", "route"],
+    "iptables.txt": ["iptables-save"],
+    "proc-net-dev.txt": ["cat", "/proc/net/dev"],
+    "proc-net-tcp.txt": ["cat", "/proc/net/tcp"],
+    "conntrack.txt": ["conntrack", "-L"],
+}
+
+
+class CaptureManager:
+    def __init__(self, provider=None):
+        self._provider = provider
+
+    def capture_network(self, job: CaptureJob, work_dir: str) -> str:
+        """Run the packet capture; returns the capture-file path."""
+        provider = self._provider or best_provider()
+        stamp = datetime.datetime.now().strftime("%Y%m%d%H%M%S")
+        # Providers own their file format: .pcap for tcpdump/socket/
+        # replay, .etl for netsh (the path returned IS the file written).
+        suffix = getattr(provider, "suffix", ".pcap")
+        pcap = os.path.join(
+            work_dir, f"{job.job_name()}-{stamp}{suffix}"
+        )
+        _log.info(
+            "capturing on %s: provider=%s filter=%r duration=%ds",
+            job.node_name, provider.name, job.filter_expr, job.duration_s,
+        )
+        provider.capture(
+            pcap,
+            filter_expr=job.filter_expr,
+            duration_s=job.duration_s,
+            max_size_mb=job.max_size_mb,
+            packet_size=job.packet_size_bytes,
+        )
+        return pcap
+
+    def collect_metadata(self, work_dir: str) -> list[str]:
+        """Network state dumps (CollectMetadata analog)."""
+        meta_dir = os.path.join(work_dir, "metadata")
+        os.makedirs(meta_dir, exist_ok=True)
+        written = []
+        for fname, cmd in _METADATA_CMDS.items():
+            path = os.path.join(meta_dir, fname)
+            try:
+                out = subprocess.run(
+                    cmd, capture_output=True, timeout=10
+                ).stdout
+            except (OSError, subprocess.TimeoutExpired) as e:
+                out = f"unavailable: {e}".encode()
+            with open(path, "wb") as fh:
+                fh.write(out)
+            written.append(path)
+        return written
+
+    def run_job(self, job: CaptureJob) -> list[str]:
+        """Full node-side flow: capture → metadata → tarball → outputs.
+        Returns artifact paths/URLs."""
+        with tempfile.TemporaryDirectory(prefix="retina-capture-") as wd:
+            pcap = self.capture_network(job, wd)
+            if job.include_metadata:
+                self.collect_metadata(wd)
+            tarball = os.path.join(
+                wd, os.path.splitext(os.path.basename(pcap))[0]
+                + ".tar.gz"
+            )
+            with tarfile.open(tarball, "w:gz") as tf:
+                tf.add(pcap, arcname=os.path.basename(pcap))
+                meta_dir = os.path.join(wd, "metadata")
+                if os.path.isdir(meta_dir):
+                    tf.add(meta_dir, arcname="metadata")
+            sinks = outputs_from_spec(job.output)
+            if not sinks:
+                raise RuntimeError("no enabled output location")
+            return [s.output(tarball) for s in sinks]

@@ -1,0 +1,76 @@
+"""Sketch-state checkpoint/resume.
+
+Reference analog (SURVEY.md §5.4): the reference's persistent state is
+pinned BPF maps on bpffs that survive agent restarts
+(pkg/bpf/setup_linux.go:19-56, retina_filter.c:20, conntrack.c:96); the
+agent itself is stateless. Here the analog is the device-resident sketch
+state: snapshot it to disk on shutdown (or every snapshot_interval_s) and
+restore on boot, so counters/sketches survive a restart the way pinned
+maps do.
+
+Format: one .npz of the flattened pytree leaves + a config fingerprint.
+The tree structure is a pure function of PipelineConfig, so leaves alone
+reconstruct the state; a config mismatch (different table shapes) refuses
+to load — the reference equivalent is recreating maps whose spec changed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+
+from retina_tpu.log import logger
+from retina_tpu.models.pipeline import PipelineConfig
+
+_log = logger("checkpoint")
+
+
+def _fingerprint(pcfg: PipelineConfig) -> str:
+    return json.dumps(dataclasses.asdict(pcfg), sort_keys=True)
+
+
+def save_state(path: str, state, pcfg: PipelineConfig) -> None:
+    leaves = jax.tree.flatten(state)[0]
+    host = [np.asarray(x) for x in leaves]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    np.savez_compressed(
+        tmp if tmp.endswith(".npz") else tmp,
+        __config__=np.frombuffer(
+            _fingerprint(pcfg).encode(), np.uint8
+        ),
+        **{f"leaf_{i}": a for i, a in enumerate(host)},
+    )
+    # np.savez appends .npz when missing; normalize then atomically swap.
+    actual_tmp = tmp if tmp.endswith(".npz") else tmp + ".npz"
+    os.replace(actual_tmp, path)
+    _log.info("state checkpoint written: %s (%d leaves)", path, len(host))
+
+
+def load_state(path: str, sharded, pcfg: PipelineConfig):
+    """Restore into a zero state built by ``sharded.init_state()``."""
+    with np.load(path) as z:
+        stored_cfg = bytes(z["__config__"]).decode()
+        if stored_cfg != _fingerprint(pcfg):
+            raise ValueError(
+                "checkpoint config mismatch; refusing to load "
+                "(table shapes changed — start fresh)"
+            )
+        zero = sharded.init_state()
+        leaves, treedef = jax.tree.flatten(zero)
+        loaded = []
+        for i, leaf in enumerate(leaves):
+            a = z[f"leaf_{i}"]
+            if a.shape != leaf.shape or a.dtype != leaf.dtype:
+                raise ValueError(
+                    f"checkpoint leaf {i} shape/dtype mismatch: "
+                    f"{a.shape}/{a.dtype} vs {leaf.shape}/{leaf.dtype}"
+                )
+            loaded.append(a)
+    state = jax.tree.unflatten(treedef, loaded)
+    _log.info("state checkpoint restored: %s", path)
+    return state

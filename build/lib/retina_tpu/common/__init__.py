@@ -1,0 +1,44 @@
+"""Shared domain objects and pubsub topics.
+
+Reference analog: pkg/common — RetinaEndpoint/RetinaSvc/RetinaNode identity
+objects (endpoint.go), DirtyCache (dirtycache.go), pubsub topic constants
+(pubsubtopics.go), apiretry.
+"""
+
+from retina_tpu.common.objects import (
+    POD_ANNOTATION,
+    POD_ANNOTATION_VALUE,
+    DirtyCache,
+    IPFamily,
+    RetinaEndpoint,
+    RetinaNode,
+    RetinaSvc,
+    retry,
+)
+from retina_tpu.common.topics import (
+    TOPIC_APISERVER,
+    TOPIC_ENDPOINTS,
+    TOPIC_NAMESPACES,
+    TOPIC_NODES,
+    TOPIC_PODS,
+    TOPIC_SERVICES,
+    TOPIC_SNAPSHOT,
+)
+
+__all__ = [
+    "DirtyCache",
+    "IPFamily",
+    "RetinaEndpoint",
+    "RetinaNode",
+    "RetinaSvc",
+    "retry",
+    "POD_ANNOTATION",
+    "POD_ANNOTATION_VALUE",
+    "TOPIC_APISERVER",
+    "TOPIC_ENDPOINTS",
+    "TOPIC_NAMESPACES",
+    "TOPIC_NODES",
+    "TOPIC_PODS",
+    "TOPIC_SERVICES",
+    "TOPIC_SNAPSHOT",
+]

@@ -1,0 +1,311 @@
+"""Layered agent configuration.
+
+Reference analog: pkg/config/config.go:59-125 — viper merges a YAML file
+with ``RETINA_``-prefixed environment variables into one static ``Config``
+struct consumed by the daemon. Same layering here: dataclass defaults ←
+YAML file ← ``RETINA_*`` env vars (env wins), via :func:`load_config`.
+
+TPU-specific knobs (batch capacity, window length, mesh shape, pipeline
+table sizes) live alongside the reference's flags because in this framework
+the "kernel" is the jit-compiled pipeline and its compile-time shape IS
+configuration — the analog of the reference injecting config into eBPF via
+generated dynamic.h macros (packetparser_linux.go:82-127).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+import yaml
+
+# Data aggregation levels (reference pkg/config/config.go:16-23).
+AGG_LOW = "low"
+AGG_HIGH = "high"
+
+DEFAULT_PLUGINS = ["packetparser", "dropreason", "packetforward", "dns"]
+
+
+@dataclasses.dataclass
+class Config:
+    """Static agent configuration (reference Config, config.go:59-77)."""
+
+    # --- reference-parity fields ---
+    api_server_addr: str = "127.0.0.1:10093"
+    enabled_plugins: list[str] = dataclasses.field(
+        default_factory=lambda: list(DEFAULT_PLUGINS)
+    )
+    metrics_interval_s: float = 10.0  # map-read plugin cadence
+    # /metrics render cache TTL (rendering tens of thousands of pod
+    # series is Python-heavy; gauges only change at publish cadence, so
+    # a sub-interval cache is lossless). 0 = render every scrape.
+    metrics_cache_ttl_s: float = 0.5
+    enable_telemetry: bool = False
+    enable_pod_level: bool = True
+    remote_context: bool = False
+    enable_annotations: bool = False
+    enable_conntrack_metrics: bool = True
+    bypass_lookup_ip_of_interest: bool = False
+    data_aggregation_level: str = AGG_LOW
+    telemetry_interval_s: float = 900.0
+    enable_hubble: bool = False  # flow-relay control plane (cmd/hubble)
+    hubble_addr: str = "127.0.0.1:4244"
+    hubble_ring_capacity: int = 1 << 12
+    # Dedicated hubble metrics mux (reference :9965); "" disables.
+    hubble_metrics_addr: str = ""
+    # TLS for the flow relay (reference hubble TLS options). PEM paths;
+    # client CA set => mutual TLS required.
+    hubble_tls_cert: str = ""
+    hubble_tls_key: str = ""
+    hubble_tls_client_ca: str = ""
+    # Local-client unix endpoint beside TCP (the reference serves
+    # unix:///var/run/cilium/hubble.sock, SURVEY §3.5). "" disables.
+    hubble_sock_path: str = ""
+    # Static peer list for the peer service: [{"name", "address"}].
+    hubble_peers: list = dataclasses.field(default_factory=list)
+    node_name: str = ""
+    # Identity from a real cluster: core/v1 pods/services/nodes list+watch
+    # feeding the cache (pkg/k8s watcher analog). "" = in-process only.
+    kubeconfig: str = ""
+    kube_namespace: str = ""  # namespace scope for pod/service watches
+    # Pod identity source when watching a cluster: "pods" (core/v1) or
+    # "cilium" (consume the Cilium CNI's CiliumEndpoints — the
+    # cilium-crds interop mode; services/nodes still come from core/v1).
+    identity_source: str = "pods"
+
+    # --- multi-host distributed runtime (jax.distributed over DCN;
+    # SURVEY.md §5.8: cross-slice merges ride the distributed runtime
+    # while intra-slice psum rides ICI). "" = single-process. ---
+    distributed_coordinator: str = ""  # "host:port" of process 0
+    distributed_num_processes: int = 1
+    distributed_process_id: int = 0
+    log_level: str = "info"
+    log_file: str = ""  # empty = stderr only
+
+    # --- event source (the kernel-hook analog; SURVEY.md §7 mapping) ---
+    event_source: str = "synthetic"  # synthetic | pcap | live | external
+    pcap_path: str = ""  # replay file for event_source=pcap
+    pcap_loop: bool = True  # loop the replay
+    synthetic_rate: float = 1e6  # target events/s for the generator
+    synthetic_flows: int = 100_000
+    # Pre-generate this many 8192-event blocks at compile() and cycle
+    # them in the feed loop (0 = generate live). Keeps the numpy
+    # generator out of the hot loop for max-rate benchmarking — the
+    # trafficgen-replay analog.
+    synthetic_pregen: int = 0
+    capture_iface: str = ""  # live AF_PACKET interface ("" = default)
+    external_socket: str = "/tmp/retina-events.sock"  # external feed
+    # Cilium agent monitor socket (gob payload stream) for the
+    # ciliumeventobserver plugin (reference config.go MonitorSockPath).
+    monitor_sock_path: str = "/var/run/cilium/monitor1_2.sock"
+    # pktmon plugin (Windows): stream-server command + its socket. ""
+    # command = the platform default (controller-pktmon.exe).
+    pktmon_command: str = ""
+    pktmon_socket: str = ""
+
+    # --- TPU runtime knobs ---
+    device_platform: str = ""  # "" = let JAX pick; "cpu" to force host
+    # Persistent XLA compilation cache: full-shape pipeline compile is
+    # ~100 s on TPU; caching it makes agent restarts (and the <1 s scrape
+    # SLA after restart) feasible. "" disables (default: opt in via the
+    # deploy configmap — DEFAULT_CACHE_DIR — so bare library/test use
+    # never touches global host state).
+    compilation_cache_dir: str = ""
+    batch_capacity: int = 1 << 15  # events per device batch
+    window_seconds: float = 1.0  # entropy/anomaly window
+    # Host-side batching latency when the dispatch pipeline is IDLE: a
+    # lightly-loaded agent flushes small batches at this cadence for
+    # low metric latency.
+    flush_interval_s: float = 0.05
+    # Under load (dispatches in flight) the feed keeps accumulating past
+    # flush_interval_s — bigger quanta raise the combine ratio and
+    # amortize per-flush fixed costs — but never beyond this age. Must
+    # stay below the metrics publish interval (1s) or scrapes lag.
+    flush_max_age_s: float = 0.4
+    mesh_devices: int = 0  # 0 = all local devices
+    # Host-side RLE combining before the host->device transfer (the eBPF
+    # map pre-aggregation analog, parallel/combine.py). Lossless; off only
+    # for debugging raw row flow.
+    host_combine: bool = True
+    # Worker threads for the native combiner (combine.cpp
+    # rt_combine_mt): per-thread partial combines + one small merge.
+    # 0 = auto (RETINA_COMBINE_THREADS env, else cores-1 capped at 4 —
+    # 1 on single-core hosts, i.e. the single-threaded pass).
+    host_combine_threads: int = 0
+    # Depth of the in-flight transfer queue between the batcher thread and
+    # the device dispatch thread (engine.py), and the bound on concurrent
+    # fire-and-forget device submissions (transfers queued back-to-back on
+    # the device proxy so the host->device link never idles between
+    # dispatch round-trips). 0 = synchronous dispatch on the feed thread
+    # (no overlap).
+    feed_pipeline_depth: int = 3
+    # Max windows of batch_capacity coalesced into ONE host->device
+    # transfer when a flush quantum combines to more than one device
+    # batch: the wire crosses the link once and is sliced into
+    # batch_capacity-sized step inputs on device. Amortizes per-transfer
+    # round-trip latency (dominant on high-RTT links; one RTT per flush
+    # instead of one per device batch).
+    feed_coalesce_windows: int = 4
+    # Smallest power-of-two host->device transfer shape: batches cross the
+    # link at their own (bucketed) size and are padded to batch_capacity
+    # on device, where HBM bandwidth makes padding free (engine pad jit).
+    transfer_min_bucket: int = 1 << 12
+    # 12-lane packed wire format (parallel/wire.py) instead of the 16-lane
+    # schema layout; unpacked on device. Off only for debugging.
+    transfer_packed: bool = True
+    # v2/v3 wire: device-resident flow-descriptor dictionary. Each
+    # distinct combined-flow descriptor crosses the link ONCE (12 lanes
+    # + id); every later occurrence crosses as an 8-byte
+    # [id | packets << id_bits, bytes] pair and the descriptor lanes are
+    # gathered back from HBM (parallel/flowdict.py + engine ingest).
+    # Steady-state wire bytes/event drop ~6x on long-lived flows.
+    # Requires transfer_packed.
+    wire_flow_dict: bool = True
+    # Device descriptor-table slots (48 B/slot/device). Must exceed the
+    # live distinct-descriptor count or the dictionary cycles
+    # (generation clear -> one re-upload burst).
+    flow_dict_slots: int = 1 << 18
+    # Under sustained load, accumulate up to this many events per
+    # combine+flush quantum (bigger quanta raise the combine ratio — more
+    # duplicate descriptors per pass — at bounded added latency). The
+    # flush_interval_s timeout still bounds latency at low rates.
+    flush_max_events: int = 1 << 21
+    snapshot_dir: str = ""  # sketch-state checkpoint dir ("" = off)
+    snapshot_interval_s: float = 0.0  # 0 = only on shutdown
+
+    # --- pipeline shapes (jit keys; see models/pipeline.py) ---
+    n_pods: int = 1 << 12
+    cms_width: int = 1 << 15
+    cms_depth: int = 4
+    topk_slots: int = 1 << 11
+    hll_precision: int = 12
+    entropy_buckets: int = 1 << 12
+    conntrack_slots: int = 1 << 18
+    identity_slots: int = 1 << 16
+
+    def validate(self) -> None:
+        if self.identity_source not in ("pods", "cilium"):
+            raise ValueError(
+                f"identity_source must be 'pods' or 'cilium', "
+                f"got {self.identity_source!r}"
+            )
+        if self.data_aggregation_level not in (AGG_LOW, AGG_HIGH):
+            raise ValueError(
+                f"dataAggregationLevel must be {AGG_LOW!r} or {AGG_HIGH!r}, "
+                f"got {self.data_aggregation_level!r}"
+            )
+        for f in ("batch_capacity", "n_pods", "cms_width", "topk_slots",
+                  "entropy_buckets", "conntrack_slots", "identity_slots"):
+            v = getattr(self, f)
+            if v <= 0 or (v & (v - 1)):
+                raise ValueError(f"{f} must be a positive power of two, got {v}")
+
+
+_BOOL_TRUE = {"1", "true", "yes", "on"}
+
+
+def _coerce(value: str, target_type: Any) -> Any:
+    if target_type is bool:
+        return value.strip().lower() in _BOOL_TRUE
+    if target_type is int:
+        return int(value, 0)
+    if target_type is float:
+        return float(value)
+    if target_type is list or target_type == list[str]:
+        return [p.strip() for p in value.split(",") if p.strip()]
+    return value
+
+
+# YAML keys accepted in camelCase (reference configmap style) or snake_case.
+def _normalize_key(key: str) -> str:
+    out = []
+    for ch in key:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out).lstrip("_")
+
+
+_ALIASES = {
+    "enabled_plugin": "enabled_plugins",
+    "enabled_plugin_linux": "enabled_plugins",
+    "metrics_interval_duration": "metrics_interval_s",
+    "telemetry_interval": "telemetry_interval_s",
+}
+
+
+def load_config(
+    path: str | None = None,
+    overrides: dict[str, Any] | None = None,
+    env: dict[str, str] | None = None,
+) -> Config:
+    """YAML file ← RETINA_* env ← explicit overrides (later wins)."""
+    cfg = Config()
+    fields = {f.name: f for f in dataclasses.fields(Config)}
+
+    def apply(key: str, raw: Any, from_env: bool) -> None:
+        key = _ALIASES.get(_normalize_key(key), _normalize_key(key))
+        if key not in fields:
+            return  # unknown keys ignored, like viper
+        f = fields[key]
+        ftype = f.type if not isinstance(f.type, str) else {
+            "str": str, "int": int, "float": float, "bool": bool,
+            "list[str]": list,
+        }.get(f.type, str)
+        if from_env or isinstance(raw, str) and ftype is not str:
+            raw = _coerce(str(raw), ftype)
+        setattr(cfg, key, raw)
+
+    if path:
+        with open(path) as fh:
+            doc = yaml.safe_load(fh) or {}
+        if not isinstance(doc, dict):
+            raise ValueError(f"config file {path} must be a YAML mapping")
+        for k, v in doc.items():
+            apply(k, v, from_env=False)
+
+    env = dict(os.environ if env is None else env)
+    for k, v in env.items():
+        if k.startswith("RETINA_"):
+            apply(k[len("RETINA_"):].lower(), v, from_env=True)
+
+    for k, v in (overrides or {}).items():
+        apply(k, v, from_env=False)
+
+    cfg.validate()
+    return cfg
+
+
+# Where the deploy manifests point compilation_cache_dir on a node.
+DEFAULT_CACHE_DIR = "/var/cache/retina-tpu/xla"
+
+
+def enable_compilation_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Returns True if enabled. Failure (unwritable dir, old jax) is
+    non-fatal but logged: the agent still boots, restarts just pay the
+    full compile again. JAX's default min-compile-time/size thresholds
+    are kept — the target is the ~100 s fused-step compile, and the
+    thresholds stop trivial compiles from growing the dir unboundedly.
+    """
+    if not cache_dir:
+        return False
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        return True
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        from retina_tpu.log import logger
+
+        logger("config").warning(
+            "compilation cache at %s unavailable (%s: %s); "
+            "restarts will pay full XLA compile",
+            cache_dir, type(e).__name__, e,
+        )
+        return False

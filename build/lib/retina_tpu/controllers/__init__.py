@@ -1,0 +1,1 @@
+"""Controllers: identity cache + reconcilers (reference pkg/controllers)."""

@@ -1,0 +1,36 @@
+"""CRD-shaped API types (reference crd/api/v1alpha1).
+
+No kube-apiserver exists here, so "CRDs" are dataclasses with the same
+shape + validation rules, loadable from YAML (the operator and CLI consume
+them the way the reference's controllers consume CRs).
+"""
+
+from retina_tpu.crd.types import (
+    Capture,
+    CaptureOutput,
+    CaptureSpec,
+    CaptureStatus,
+    CaptureTarget,
+    MetricsConfiguration,
+    MetricsContextOptions,
+    MetricsNamespaces,
+    MetricsSpec,
+    TracesConfiguration,
+    TracesSpec,
+    ValidationError,
+)
+
+__all__ = [
+    "Capture",
+    "CaptureOutput",
+    "CaptureSpec",
+    "CaptureStatus",
+    "CaptureTarget",
+    "MetricsConfiguration",
+    "MetricsContextOptions",
+    "MetricsNamespaces",
+    "MetricsSpec",
+    "TracesConfiguration",
+    "TracesSpec",
+    "ValidationError",
+]

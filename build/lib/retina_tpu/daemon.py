@@ -1,0 +1,309 @@
+"""Standard agent daemon: the boot sequence.
+
+Reference analog: cmd/standard/daemon.go:80-323 — Daemon.Start loads
+config, sets up zap + telemetry + metrics, builds the controller-runtime
+manager, wires pubsub/cache/enricher/filtermanager/metrics-module when
+pod-level is on (:239-295), then runs the controller manager until SIGTERM
+cancels the context and the Stop cascade runs.
+
+Here: config → logging → ControllerManager (server + engine + plugins +
+watchers) → MetricsModule (pod-level) → signal-driven stop event. The
+driver-facing entry is :func:`run_agent`; ``python -m retina_tpu`` calls
+it via the CLI.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any, Optional
+
+from retina_tpu.config import Config, enable_compilation_cache, load_config
+from retina_tpu.crd.types import MetricsConfiguration
+from retina_tpu.log import logger, setup_logger
+from retina_tpu.managers.controllermanager import ControllerManager
+from retina_tpu.module.metrics_module import MetricsModule
+
+
+class Daemon:
+    def __init__(self, cfg: Config, apiserver_host: str = ""):
+        self.cfg = cfg
+        self.log = logger("daemon")
+        if enable_compilation_cache(cfg.compilation_cache_dir):
+            self.log.info("XLA compilation cache at %s",
+                          cfg.compilation_cache_dir)
+        self.cm = ControllerManager(cfg, apiserver_host=apiserver_host)
+        # Identity from a real cluster (pkg/k8s watcher analog): core/v1
+        # pods/services/nodes land in the same cache the CRD-store path
+        # feeds, so enrichment works without our operator running.
+        # Selected by an explicit kubeconfig OR automatically when running
+        # in-cluster with a service account (the daemonset deployment).
+        self.kubewatch = None
+        self.ciliumwatch = None
+        from retina_tpu.operator.kubeclient import in_cluster_available
+
+        if cfg.kubeconfig or in_cluster_available():
+            from retina_tpu.operator.kubewatch import CoreWatcher
+
+            use_cilium = cfg.identity_source == "cilium"
+            self.kubewatch = CoreWatcher(
+                self.cm.cache, cfg.kubeconfig,
+                namespace=cfg.kube_namespace,
+                include_pods=not use_cilium,
+                include_namespaces=cfg.enable_annotations,
+            )
+            if use_cilium:
+                # Identity from the foreign CNI's objects (cilium-crds
+                # interop): CEPs instead of core/v1 pods.
+                if cfg.enable_annotations:
+                    # CEPs carry identity labels, not pod annotations:
+                    # per-POD retina.sh=observe opt-in cannot work in
+                    # this mode; namespace-level opt-in still does.
+                    self.log.warning(
+                        "identity_source=cilium: per-pod observe "
+                        "annotations are invisible (CiliumEndpoints "
+                        "carry no pod annotations); use the namespace "
+                        "annotation instead"
+                    )
+                from retina_tpu.operator.cilium import CiliumWatcher
+
+                self.ciliumwatch = CiliumWatcher(
+                    self.cm.cache, cfg.kubeconfig,
+                    namespace=cfg.kube_namespace,
+                )
+        self.metrics_module: Optional[MetricsModule] = None
+        self._mm_thread: Optional[threading.Thread] = None
+        self.hubble = None
+        self.monitoragent = None
+        if cfg.enable_hubble:
+            # Hubble CP rides alongside (cmd/hubble cell graph analog):
+            # plugins mirror events into the external channel; the monitor
+            # agent fans them out to the flow observer; the gRPC relay
+            # serves GetFlows (SURVEY.md §3.5).
+            from retina_tpu.hubble import (
+                FlowObserver,
+                HubbleServer,
+                MonitorAgent,
+            )
+
+            self.monitoragent = MonitorAgent()
+            dns_plugin = self.cm.pluginmanager.plugins.get("dns")
+            self.observer = FlowObserver(
+                capacity=cfg.hubble_ring_capacity,
+                cache=self.cm.cache,
+                dns_resolver=(dns_plugin.resolve if dns_plugin else None),
+            )
+            self.monitoragent.register_consumer(self.observer.consume)
+            self.cm.pluginmanager.setup_channel(self.monitoragent.channel)
+            # Peer set = static config peers + the node store (nodes the
+            # operator publishes land in the cache; the peer service then
+            # reflects live cluster membership, not boot-time config).
+            def _peers() -> list[dict[str, str]]:
+                # Peers serve on the same configured hubble port; with an
+                # ephemeral bind (tests) fall back to our bound port.
+                port = cfg.hubble_addr.rsplit(":", 1)[1]
+                if port == "0" and self.hubble is not None:
+                    port = str(self.hubble.port)
+                out = [dict(p) for p in cfg.hubble_peers]
+                seen = {p.get("address") for p in out}
+                for n in self.cm.cache.list_nodes():
+                    if n.ip and n.name != cfg.node_name:
+                        addr = f"{n.ip}:{port}"
+                        if addr not in seen:
+                            out.append({"name": n.name, "address": addr})
+                return out
+
+            self.hubble = HubbleServer(
+                self.observer,
+                addr=cfg.hubble_addr,
+                peers=_peers,
+                node_name=cfg.node_name,
+                tls_cert=cfg.hubble_tls_cert,
+                tls_key=cfg.hubble_tls_key,
+                tls_client_ca=cfg.hubble_tls_client_ca,
+                unix_socket=cfg.hubble_sock_path,
+            )
+            self.hubble_metrics_server = None
+            if cfg.hubble_metrics_addr:
+                # Dedicated hubble metrics mux (:9965 analog): serves ONLY
+                # the hubble registry so scraping both muxes never
+                # double-ingests the node/pod families.
+                from retina_tpu.exporter import get_exporter
+                from retina_tpu.server import Server
+
+                self.hubble_metrics_server = Server(
+                    cfg.hubble_metrics_addr,
+                    gather=get_exporter().gather_hubble_text,
+                    metrics_cache_ttl_s=cfg.metrics_cache_ttl_s,
+                )
+        if cfg.enable_pod_level:
+            dns_plugin = self.cm.pluginmanager.plugins.get("dns")
+            self.metrics_module = MetricsModule(
+                cfg,
+                engine=self.cm.engine,
+                cache=self.cm.cache,
+                filtermanager=self.cm.filtermanager,
+                pubsub=self.cm.pubsub,
+                dns_resolver=(dns_plugin.resolve if dns_plugin else None),
+            )
+        # Per-flow trace sampling off the record stream (module/traces):
+        # idle until a TracesConfiguration reconcile names targets,
+        # queried via /debug/vars -> CLI `retina-tpu trace`.
+        from retina_tpu.module.traces import TracesModule
+
+        self.traces_module = TracesModule()
+        self.traces_module.attach(self.cm.engine)
+        # Agent-side CRD reconcile (the reference daemon watches its
+        # module CRDs itself, pkg/controllers/daemon): a list+watch
+        # bridge feeds a local store whose watches drive the metrics +
+        # traces modules — without this, only the OPERATOR process would
+        # see the CRs and the agent's modules would never reconcile.
+        self.crd_bridge = None
+        if cfg.kubeconfig or in_cluster_available():
+            try:
+                from retina_tpu.operator.bridge import KubeBridge
+                from retina_tpu.operator.store import CRDStore
+
+                crd_store = CRDStore()
+                crd_store.watch(
+                    "MetricsConfiguration", self._on_metrics_crd
+                )
+                crd_store.watch(
+                    "TracesConfiguration", self._on_traces_crd
+                )
+                self.crd_bridge = KubeBridge(
+                    crd_store, cfg.kubeconfig,
+                    namespace=cfg.kube_namespace,
+                    # Only the module CRs: Captures are the operator's
+                    # business, and N agents each LISTing every Capture
+                    # is pure apiserver load.
+                    kinds=["MetricsConfiguration",
+                           "TracesConfiguration"],
+                )
+            except Exception as e:
+                self.log.warning("agent CRD bridge unavailable: %s", e)
+
+    # -- module CRD reconciles (agent side) ---------------------------
+    def _on_metrics_crd(self, event: str, conf: Any) -> None:
+        if self.metrics_module is None:
+            return
+        try:
+            if event == "deleted":
+                self.metrics_module.reconcile(
+                    MetricsConfiguration.default()
+                )
+            elif event == "applied":
+                self.metrics_module.reconcile(conf)
+        except Exception:
+            self.log.exception("metrics CRD reconcile failed")
+
+    def _on_traces_crd(self, event: str, conf: Any) -> None:
+        from retina_tpu.crd.types import TracesConfiguration
+
+        try:
+            if event == "deleted":
+                self.traces_module.reconcile(TracesConfiguration())
+            elif event == "applied":
+                self.traces_module.reconcile(conf)
+        except Exception:
+            self.log.exception("traces CRD reconcile failed")
+
+    def start(self, stop: threading.Event) -> None:
+        self.log.info(
+            "starting retina-tpu agent: plugins=%s source=%s pod_level=%s",
+            self.cfg.enabled_plugins, self.cfg.event_source,
+            self.cfg.enable_pod_level,
+        )
+        self.cm.init()
+        if self.cm.server is not None:
+            from retina_tpu.module.traces import MAX_EVENTS_PER_TARGET
+
+            self.cm.server.expose_var(
+                "traces",
+                lambda: self.traces_module.traces(
+                    limit=MAX_EVENTS_PER_TARGET
+                ),
+            )
+            self.cm.server.expose_var(
+                "traces_stats", self.traces_module.stats
+            )
+        if self.monitoragent is not None:
+            self.monitoragent.start(stop)
+        if self.hubble is not None:
+            self.hubble.start()
+            if getattr(self, "hubble_metrics_server", None) is not None:
+                self.hubble_metrics_server.start()
+        if self.metrics_module is not None:
+            self.metrics_module.reconcile(MetricsConfiguration.default())
+            self._mm_thread = threading.Thread(
+                target=self.metrics_module.start, args=(stop,),
+                name="metricsmodule", daemon=True,
+            )
+            self._mm_thread.start()
+        if self.cfg.snapshot_dir:
+            import os
+
+            path = os.path.join(self.cfg.snapshot_dir, "sketch_state.npz")
+            if os.path.exists(path):
+                try:
+                    self.cm.engine.load_snapshot_state(path)
+                    self.log.info("resumed sketch state from %s", path)
+                except Exception as e:
+                    # Any unreadable checkpoint (stale fingerprint, corrupt
+                    # or truncated npz) must not crash-loop the agent: move
+                    # it aside and start fresh.
+                    self.log.warning("checkpoint ignored (%s): %s",
+                                     type(e).__name__, e)
+                    try:
+                        os.replace(path, path + ".bad")
+                    except OSError:
+                        pass
+        if self.kubewatch is not None:
+            self.kubewatch.start()
+        if self.ciliumwatch is not None:
+            self.ciliumwatch.start()
+        if self.crd_bridge is not None:
+            self.crd_bridge.start()
+        try:
+            self.cm.start(stop)  # blocks until stop fires; runs shutdown
+        finally:
+            if self.crd_bridge is not None:
+                self.crd_bridge.stop()
+            if self.ciliumwatch is not None:
+                self.ciliumwatch.stop()
+            if self.kubewatch is not None:
+                self.kubewatch.stop()
+            if self.hubble is not None:
+                self.hubble.stop()
+                if getattr(self, "hubble_metrics_server", None) is not None:
+                    self.hubble_metrics_server.stop()
+
+
+def run_agent(
+    config_path: str | None = None,
+    overrides: dict[str, Any] | None = None,
+    apiserver_host: str = "",
+    install_signals: bool = True,
+) -> Daemon:
+    """Build + run the agent (blocking). SIGTERM/SIGINT → clean stop."""
+    cfg = load_config(config_path, overrides=overrides)
+    setup_logger(cfg.log_level, cfg.log_file)
+    if cfg.distributed_coordinator:
+        # Multi-host mesh: must run before any backend use so every
+        # process sees the global device set (jax.devices() spans hosts;
+        # shard_map collectives then ride ICI within a slice and DCN
+        # across hosts — no hand-written NCCL/MPI analog).
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=cfg.distributed_coordinator,
+            num_processes=cfg.distributed_num_processes,
+            process_id=cfg.distributed_process_id,
+        )
+    stop = threading.Event()
+    if install_signals:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: stop.set())
+    d = Daemon(cfg, apiserver_host=apiserver_host)
+    d.start(stop)
+    return d

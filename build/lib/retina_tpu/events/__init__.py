@@ -1,0 +1,37 @@
+"""Event data plane: record schema, batching, and sources.
+
+Reference analog: the eBPF `struct packet` (conntrack.c:33-49) carried over
+perf rings into `flow.Flow` protobufs (pkg/utils/flow_utils.go:33-130).
+Here an event is a row of fixed-width uint32 columns so a batch is a dense
+(B, NUM_FIELDS) device tensor — the shape the TPU vector units want.
+"""
+
+from retina_tpu.events.schema import (  # noqa: F401
+    EventBatch,
+    F,
+    NUM_FIELDS,
+    RECORD_BYTES,
+    DIR_INGRESS,
+    DIR_EGRESS,
+    OP_TO_STACK,
+    OP_TO_ENDPOINT,
+    OP_FROM_NETWORK,
+    OP_TO_NETWORK,
+    VERDICT_FORWARDED,
+    VERDICT_DROPPED,
+    EV_FORWARD,
+    EV_DROP,
+    EV_DNS_REQ,
+    EV_DNS_RESP,
+    EV_TCP_RETRANS,
+    PROTO_TCP,
+    PROTO_UDP,
+    TCP_FIN,
+    TCP_SYN,
+    TCP_RST,
+    TCP_PSH,
+    TCP_ACK,
+    TCP_URG,
+    TCP_ECE,
+    TCP_CWR,
+)

@@ -1,0 +1,20 @@
+"""Hubble-analog flow control plane (reference pkg/hubble, pkg/monitoragent).
+
+The reference's second control plane streams enriched flows over gRPC
+(:4244 relay, unix socket locally) from a ring-buffer observer fed by the
+monitor agent. Same architecture here:
+
+- monitoragent: drains the plugins' external channel, fans out to
+  consumers (pkg/monitoragent).
+- flow: record → flow-dict decoding with identity enrichment (pkg/hubble/
+  parser layer34 + seven/DNS).
+- observer: fixed-capacity flow ring with follow cursors (the Cilium
+  container.Ring analog) + filter evaluation.
+- server/client: the gRPC flow relay. The image has no protoc-gen-grpc,
+  so services use gRPC generic handlers with msgpack frames instead of
+  protobuf codegen — the transport is still gRPC/HTTP2 streaming.
+"""
+
+from retina_tpu.hubble.monitoragent import MonitorAgent
+from retina_tpu.hubble.observer import FlowObserver
+from retina_tpu.hubble.server import HubbleServer

@@ -1,0 +1,1 @@
+"""Management layer (reference pkg/managers, SURVEY.md §2.4)."""

@@ -1,0 +1,140 @@
+"""PluginManager: plugin lifecycle + supervision.
+
+Reference analog: pkg/managers/pluginmanager/pluginmanager.go —
+instantiate enabled plugins from the registry (:60-66), Reconcile each
+(Generate→Compile→Stop→Init under a 10s SLA, :27-28, :91-113), start each
+in an errgroup where any plugin's fatal error tears the whole agent down
+for a clean restart (:154-179), broadcast SetupChannel (:206-212), and run
+conntrack GC only when packetparser is on (:140-151).
+
+Differences by design: plugins raising UnsupportedPlatform at reconcile
+are skipped with a warning (the reference compiles them out per-OS);
+reconcile failures are counted in the same
+plugin_manager_failed_to_reconcile series.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Optional
+
+from retina_tpu.config import Config
+from retina_tpu.log import logger
+from retina_tpu.metrics import get_metrics
+from retina_tpu.plugins import registry
+from retina_tpu.plugins.api import EventSink, Plugin, UnsupportedPlatform
+
+RECONCILE_SLA_S = 10.0  # pluginmanager.go:25-28
+
+
+class PluginManager:
+    def __init__(
+        self,
+        cfg: Config,
+        sink: Optional[EventSink] = None,
+        engine: Optional[Any] = None,
+    ):
+        self._log = logger("pluginmanager")
+        self.cfg = cfg
+        self.engine = engine
+        self.plugins: dict[str, Plugin] = {}
+        self.errors: list[tuple[str, BaseException]] = []
+        self._threads: dict[str, threading.Thread] = {}
+        self._stop = threading.Event()
+        self._fatal = threading.Event()
+
+        import retina_tpu.plugins  # noqa: F401  (self-registration)
+
+        enabled = list(cfg.enabled_plugins)
+        # Conntrack GC rides along when packetparser is enabled
+        # (pluginmanager.go:140-151).
+        if "packetparser" in enabled and "conntrack" not in enabled:
+            enabled.append("conntrack")
+        for name in enabled:
+            ctor = registry.get(name)  # KeyError is fatal, like the reference
+            p = ctor(cfg)
+            if sink is not None:
+                p.set_sink(sink)
+            self.plugins[name] = p
+        if engine is not None:
+            ct = self.plugins.get("conntrack")
+            if ct is not None and hasattr(ct, "attach_engine"):
+                ct.attach_engine(engine)
+            dns = self.plugins.get("dns")
+            if dns is not None and hasattr(dns, "observe_records"):
+                engine.add_observer(
+                    lambda rec, plugin: dns.observe_records(rec)
+                )
+
+    # -- reconcile (pluginmanager.go:91-113) ---------------------------
+    def reconcile(self, name: str) -> bool:
+        p = self.plugins[name]
+        t0 = time.perf_counter()
+        try:
+            p.generate()
+            p.compile()
+            p.stop()
+            p.init()
+        except UnsupportedPlatform as e:
+            self._log.warning("plugin %s unsupported here: %s", name, e)
+            del self.plugins[name]
+            return False
+        except Exception:
+            get_metrics().plugin_reconcile_failures.labels(plugin=name).inc()
+            self._log.exception("plugin %s reconcile failed", name)
+            raise
+        took = time.perf_counter() - t0
+        if took > RECONCILE_SLA_S:
+            self._log.warning(
+                "plugin %s reconcile took %.1fs (SLA %.0fs)",
+                name, took, RECONCILE_SLA_S,
+            )
+        return True
+
+    def setup_channel(self, q: queue.Queue) -> None:
+        """Broadcast the external channel (pluginmanager.go:206-212)."""
+        for p in self.plugins.values():
+            p.setup_channel(q)
+
+    # -- start/stop (pluginmanager.go:116-193) -------------------------
+    def start(self, stop: threading.Event) -> None:
+        """Reconcile + launch every plugin; returns once all are running.
+        Any plugin's crash sets ``stop`` (errgroup semantics)."""
+        self._stop = stop
+        for name in list(self.plugins):
+            self.reconcile(name)
+
+        def run(name: str, p: Plugin) -> None:
+            try:
+                p.start(stop)
+            except UnsupportedPlatform as e:
+                self._log.warning("plugin %s stopped: %s", name, e)
+            except Exception as e:
+                self._log.exception("plugin %s crashed", name)
+                self.errors.append((name, e))
+                self._fatal.set()
+                stop.set()  # tear down the agent for a clean restart
+
+        for name, p in self.plugins.items():
+            t = threading.Thread(
+                target=run, args=(name, p), name=f"plugin-{name}", daemon=True
+            )
+            t.start()
+            self._threads[name] = t
+        self._log.info("started plugins: %s", sorted(self.plugins))
+
+    def stop(self) -> None:
+        self._stop.set()
+        for name, t in self._threads.items():
+            t.join(timeout=2.0)
+        for name, p in self.plugins.items():
+            try:
+                p.stop()
+            except Exception:
+                self._log.exception("plugin %s stop failed", name)
+
+    @property
+    def failed(self) -> bool:
+        return self._fatal.is_set()

@@ -1,0 +1,128 @@
+"""Basic metric declarations + control-plane self metrics.
+
+Reference analog: pkg/metrics/metrics.go:14-120 — ``InitializeMetrics``
+creates every node-level gauge and control-plane counter once at daemon
+start, into the default registry. Names come from utils.metric_names
+(networkobservability_*). Advanced (pod-level) metric families are created
+by the metrics module on reconcile instead (module/metrics.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from retina_tpu.exporter import Exporter, get_exporter
+from retina_tpu.log import logger
+from retina_tpu.utils import metric_names as mn
+
+_log = logger("metrics")
+
+
+class Metrics:
+    """All basic gauges/counters, created against one Exporter."""
+
+    def __init__(self, exporter: Optional[Exporter] = None) -> None:
+        ex = exporter or get_exporter()
+        g, c = ex.new_gauge, ex.new_counter
+        # node-level data-plane gauges (metrics.go:14-80)
+        self.drop_count = g(mn.DROP_COUNT, [mn.L_REASON, mn.L_DIRECTION])
+        self.drop_bytes = g(mn.DROP_BYTES, [mn.L_REASON, mn.L_DIRECTION])
+        self.forward_count = g(mn.FORWARD_COUNT, [mn.L_DIRECTION])
+        self.forward_bytes = g(mn.FORWARD_BYTES, [mn.L_DIRECTION])
+        self.tcp_state = g(mn.TCP_STATE, [mn.L_STATE])
+        self.tcp_connection_remote = g(
+            mn.TCP_CONNECTION_REMOTE, [mn.L_IP, mn.L_PORT]
+        )
+        self.tcp_connection_stats = g(mn.TCP_CONNECTION_STATS, [mn.L_STAT])
+        self.tcp_flag_counters = g(mn.TCP_FLAG_COUNTERS, [mn.L_FLAG])
+        self.ip_connection_stats = g(mn.IP_CONNECTION_STATS, [mn.L_STAT])
+        self.udp_connection_stats = g(mn.UDP_CONNECTION_STATS, [mn.L_STAT])
+        self.interface_stats = g(
+            mn.INTERFACE_STATS, [mn.L_INTERFACE, mn.L_STAT]
+        )
+        self.infiniband_counter_stats = g(
+            mn.INFINIBAND_COUNTER_STATS, ["device", "port", mn.L_STAT]
+        )
+        self.infiniband_status_params = g(
+            mn.INFINIBAND_STATUS_PARAMS, ["interface", mn.L_STAT]
+        )
+        self.dns_request_count = g(mn.DNS_REQUEST_COUNT, [mn.L_QTYPE])
+        self.dns_response_count = g(
+            mn.DNS_RESPONSE_COUNT, [mn.L_QTYPE, mn.L_RCODE]
+        )
+        self.conntrack_packets = g(mn.CONNTRACK_PACKETS, [mn.L_DIRECTION])
+        self.active_connections = g(mn.ACTIVE_CONNECTIONS, [])
+        # Declared for external connectivity probers to set, exactly as
+        # the reference declares them unconsumed (metrics.go:49-60).
+        self.node_connectivity_status = g(
+            mn.NODE_CONNECTIVITY_STATUS, ["source_node", "target_node"]
+        )
+        self.node_connectivity_latency = g(
+            mn.NODE_CONNECTIVITY_LATENCY, ["source_node", "target_node"]
+        )
+        self.conntrack_bytes = g(mn.CONNTRACK_BYTES, [mn.L_DIRECTION])
+
+        # sketch-derived node-level series
+        self.distinct_flows = g(mn.DISTINCT_FLOWS, [])
+        self.distinct_src_per_reason = g(
+            mn.DISTINCT_SRC_PER_REASON, [mn.L_REASON]
+        )
+        self.entropy_bits = g(mn.ENTROPY_BITS, [mn.L_DIMENSION])
+        self.anomaly_flag = g(mn.ANOMALY_FLAG, [mn.L_DIMENSION])
+        self.anomaly_zscore = g(mn.ANOMALY_ZSCORE, [mn.L_DIMENSION])
+        self.anomaly_windows = c(mn.ANOMALY_WINDOWS, [mn.L_DIMENSION])
+
+        # control-plane self metrics (metrics.go:100-120)
+        self.plugin_reconcile_failures = c(
+            mn.PLUGIN_RECONCILE_FAILURES, [mn.L_PLUGIN]
+        )
+        self.lost_events = c(mn.LOST_EVENTS, [mn.L_STAGE, mn.L_PLUGIN])
+        self.lost_table_entries = c(mn.LOST_TABLE_ENTRIES, [mn.L_TABLE])
+        self.filter_push_failures = c(mn.FILTER_PUSH_FAILURES, [])
+        self.flow_dict_entries = g(mn.FLOW_DICT_ENTRIES, [])
+        self.flow_dict_generation = g(mn.FLOW_DICT_GENERATION, [])
+        self.wire_rows = c(mn.WIRE_ROWS, [mn.L_KIND])
+        self.parsed_packets = c(mn.PARSED_PACKETS, [mn.L_PLUGIN])
+        self.device_step_seconds = ex.new_histogram(
+            mn.DEVICE_STEP_SECONDS,
+            [],
+            buckets=[1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0],
+        )
+        self.device_batch_fill = g(mn.DEVICE_BATCH_FILL, [])
+        self.windows_closed = c(mn.WINDOWS_CLOSED, [])
+        # events-in / rows-transferred of the host combiner (the kernel-map
+        # aggregation factor; parallel/combine.py). 1.0 = nothing merged.
+        self.combine_ratio = g(mn.COMBINE_RATIO, [])
+        self.transfer_seconds = ex.new_histogram(
+            mn.TRANSFER_SECONDS,
+            [],
+            buckets=[1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0, 3.0],
+        )
+        self.transfer_bytes = c(mn.TRANSFER_BYTES, [])
+
+
+_singleton: Metrics | None = None
+_lock = threading.Lock()
+
+
+def initialize_metrics(exporter: Optional[Exporter] = None) -> Metrics:
+    """Idempotent metric creation (reference InitializeMetrics)."""
+    global _singleton
+    with _lock:
+        if _singleton is None:
+            _singleton = Metrics(exporter)
+        return _singleton
+
+
+def get_metrics() -> Metrics:
+    m = _singleton
+    if m is None:
+        return initialize_metrics()
+    return m
+
+
+def reset_for_tests() -> None:
+    global _singleton
+    with _lock:
+        _singleton = None

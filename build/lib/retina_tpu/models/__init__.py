@@ -1,0 +1,15 @@
+"""Detector/aggregator models composed from ops/ sketches.
+
+The reference's analog layer is pkg/module/metrics: per-metric aggregators
+implementing AdvMetricsInterface{Init, ProcessFlow, Clean} driven one flow
+at a time (metrics_module.go:283-303). Here each model is a pure pytree
+state + batched update, and the flagship TelemetryPipeline fuses all enabled
+models into ONE jitted step per event batch.
+"""
+
+from retina_tpu.models.identity import IdentityMap  # noqa: F401
+from retina_tpu.models.pipeline import (  # noqa: F401
+    PipelineConfig,
+    PipelineState,
+    TelemetryPipeline,
+)

@@ -1,0 +1,1 @@
+"""Aggregation modules (reference pkg/module): metrics + traces."""

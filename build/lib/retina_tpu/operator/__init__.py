@@ -1,0 +1,5 @@
+"""Operator: cluster-scoped reconcilers (reference operator/ +
+pkg/controllers/operator)."""
+
+from retina_tpu.operator.store import CRDStore
+from retina_tpu.operator.operator import Operator

@@ -1,0 +1,249 @@
+"""Core/v1 identity watchers: pods, services, nodes → identity cache.
+
+Reference analogs:
+- pkg/k8s/watcher_linux.go — the agent's apiserver watcher layer.
+- pkg/controllers/daemon/pod/controller.go:38-86 — Pod → slim
+  RetinaEndpoint into the cache; host-network pods ignored; pods without
+  an IP skipped; deletion (or deletionTimestamp) removes the endpoint.
+- pkg/controllers/daemon/service/controller.go — Service → RetinaSvc.
+- pkg/controllers/daemon/node/controller.go — Node → RetinaNode.
+
+Design: one list+watch thread per resource over the shared
+:class:`~retina_tpu.operator.kubeclient.KubeClient`. Translation is pure
+(`pod_to_endpoint` etc.) so it is testable without an apiserver; events
+land as upserts/deletes on :class:`~retina_tpu.controllers.cache.Cache`,
+which assigns the dense pod indexes feeding the device IdentityMap — so a
+pod appearing in the cluster becomes a joinable identity on-device after
+the next identity reconcile, exactly like a CRD-store endpoint apply.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from retina_tpu.common import (
+    POD_ANNOTATION,
+    POD_ANNOTATION_VALUE,
+    RetinaEndpoint,
+    RetinaNode,
+    RetinaSvc,
+)
+from retina_tpu.log import logger
+from retina_tpu.operator.kubeclient import KubeClient
+
+CORE_V1 = "/api/v1"
+
+
+# -- pure translations (controller.go Reconcile bodies) -----------------
+def pod_to_endpoint(doc: dict) -> Optional[RetinaEndpoint]:
+    """Pod → RetinaEndpoint; None = ignore (host-network or no IP yet,
+    pod/controller.go:61-77)."""
+    spec = doc.get("spec", {}) or {}
+    status = doc.get("status", {}) or {}
+    meta = doc.get("metadata", {}) or {}
+    if spec.get("hostNetwork"):
+        return None
+    ips = tuple(
+        e["ip"] for e in status.get("podIPs") or []
+        if e.get("ip")
+    ) or ((status.get("podIP"),) if status.get("podIP") else ())
+    if not ips:
+        return None
+    return RetinaEndpoint(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        ips=ips,
+        labels=tuple(sorted((meta.get("labels") or {}).items())),
+        owner_refs=tuple(
+            (r.get("kind", ""), r.get("name", ""))
+            for r in meta.get("ownerReferences") or []
+        ),
+        containers=tuple(
+            c.get("name", "") for c in spec.get("containers") or []
+        ),
+        annotations=tuple(sorted((meta.get("annotations") or {}).items())),
+        node=spec.get("nodeName", ""),
+    )
+
+
+def service_to_svc(doc: dict) -> RetinaSvc:
+    meta = doc.get("metadata", {}) or {}
+    spec = doc.get("spec", {}) or {}
+    status = doc.get("status", {}) or {}
+    lb_ingress = (status.get("loadBalancer") or {}).get("ingress") or []
+    return RetinaSvc(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        cluster_ip=(
+            "" if spec.get("clusterIP") in (None, "None")
+            else spec.get("clusterIP", "")
+        ),
+        lb_ip=(lb_ingress[0].get("ip", "") if lb_ingress else ""),
+        selector=tuple(sorted((spec.get("selector") or {}).items())),
+    )
+
+
+def node_to_node(doc: dict) -> RetinaNode:
+    meta = doc.get("metadata", {}) or {}
+    status = doc.get("status", {}) or {}
+    internal = next(
+        (a.get("address", "") for a in status.get("addresses") or []
+         if a.get("type") == "InternalIP"),
+        "",
+    )
+    labels = meta.get("labels") or {}
+    return RetinaNode(
+        name=meta.get("name", ""),
+        ip=internal,
+        zone=labels.get("topology.kubernetes.io/zone", ""),
+    )
+
+
+class CoreWatcher:
+    """Three list+watch loops feeding the identity cache.
+
+    When active, this watcher OWNS pod/service identity in the cache:
+    post-LIST resync deletes cache entries absent from the apiserver, so
+    don't feed the same cache from the CRD-store RetinaEndpoint path
+    concurrently (the two sources would fight; pick one per deployment,
+    as the reference does with its enable-retina-endpoint switch).
+    """
+
+    def __init__(self, cache, kubeconfig: str, namespace: str = "",
+                 retry_s: float = 2.0, include_pods: bool = True,
+                 include_services: bool = True,
+                 include_nodes: bool = True,
+                 include_namespaces: bool = False,
+                 on_pods_synced=None):
+        """``include_pods=False`` watches only services+nodes — used when
+        pod identity comes from elsewhere (CiliumEndpoints); a pods-only
+        watcher (others False) backs the operator's CEP publisher.
+        ``include_namespaces`` adds the annotated-namespace watch (the
+        enable_annotations opt-in path). ``on_pods_synced()`` fires after
+        each pod LIST resync — the publisher's restart GC hook."""
+        self._log = logger("kubewatch")
+        self.cache = cache
+        self.namespace = namespace  # "" = cluster-wide (pods/services)
+        self.retry_s = retry_s
+        self.include_pods = include_pods
+        self.include_services = include_services
+        self.include_nodes = include_nodes
+        self.include_namespaces = include_namespaces
+        self.on_pods_synced = on_pods_synced
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.client = KubeClient(kubeconfig)
+
+    # -- event handlers ------------------------------------------------
+    def _on_pod(self, event: str, doc: dict) -> None:
+        meta = doc.get("metadata", {}) or {}
+        key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+        deleting = (
+            event == "DELETED" or meta.get("deletionTimestamp") is not None
+        )
+        if deleting:
+            self.cache.delete_endpoint(key)
+            return
+        ep = pod_to_endpoint(doc)
+        if ep is not None:
+            self.cache.update_endpoint(ep)
+
+    def _on_service(self, event: str, doc: dict) -> None:
+        svc = service_to_svc(doc)
+        if event == "DELETED":
+            self.cache.delete_service(svc.key())
+        else:
+            self.cache.update_service(svc)
+
+    def _on_node(self, event: str, doc: dict) -> None:
+        # Node removal keeps the last-known entry (reference cache has no
+        # node delete either); stale nodes age out with the cluster.
+        if event != "DELETED":
+            self.cache.update_node(node_to_node(doc))
+
+    def _on_namespace(self, event: str, doc: dict) -> None:
+        """namespace_controller.go:54-62: the retina.sh=observe
+        annotation opts a whole namespace into pod-level metrics."""
+        meta = doc.get("metadata", {}) or {}
+        name = meta.get("name", "")
+        if not name:
+            return
+        annotated = (
+            event != "DELETED"
+            and meta.get("deletionTimestamp") is None
+            and (meta.get("annotations") or {}).get(POD_ANNOTATION)
+            == POD_ANNOTATION_VALUE
+        )
+        self.cache.set_annotated_namespace(name, annotated)
+
+    # -- resync (informer semantics): a re-LIST after a dropped watch
+    # must delete objects that vanished while disconnected, or stale
+    # endpoints pin dense pod indexes forever.
+    @staticmethod
+    def _keys(metas: list[dict]) -> set[str]:
+        return {
+            f"{m.get('namespace', 'default')}/{m.get('name', '')}"
+            for m in metas
+        }
+
+    def _sync_pods(self, metas: list[dict]) -> None:
+        listed = self._keys(metas)
+        for key in self.cache.list_endpoint_keys():
+            if key not in listed:
+                self.cache.delete_endpoint(key)
+        if self.on_pods_synced is not None:
+            self.on_pods_synced()
+
+    def _sync_services(self, metas: list[dict]) -> None:
+        listed = self._keys(metas)
+        for key in self.cache.list_service_keys():
+            if key not in listed:
+                self.cache.delete_service(key)
+
+    def _sync_namespaces(self, metas: list[dict]) -> None:
+        annotated = {
+            m.get("name", "") for m in metas
+            if (m.get("annotations") or {}).get(POD_ANNOTATION)
+            == POD_ANNOTATION_VALUE
+        }
+        for ns in self.cache.annotated_namespaces() - annotated:
+            self.cache.set_annotated_namespace(ns, False)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        plans = []
+        if self.include_pods:
+            plans.append(("pods", self._on_pod, self.namespace,
+                          self._sync_pods))
+        if self.include_services:
+            plans.append(("services", self._on_service, self.namespace,
+                          self._sync_services))
+        if self.include_nodes:
+            plans.append(("nodes", self._on_node, "", None))  # cluster-scoped
+        if self.include_namespaces:
+            plans.append(("namespaces", self._on_namespace, "",
+                          self._sync_namespaces))
+        for plural, handler, ns, sync in plans:
+            t = threading.Thread(
+                target=self.client.list_watch,
+                args=(CORE_V1, plural),
+                kwargs={
+                    "on_event": handler,
+                    "stop": self._stop,
+                    "namespace": ns,
+                    "retry_s": self.retry_s,
+                    "log": self._log,
+                    "on_sync": sync,
+                },
+                name=f"kubewatch-{plural}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        self._log.info("core/v1 watchers (%s) at %s",
+                       ",".join(p[0] for p in plans), self.client.server)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(2.0)

@@ -1,0 +1,10 @@
+"""Device compute kernels: hashing and sketches.
+
+These replace the reference's two aggregation tiers — kernel-side per-CPU
+hash maps (e.g. drop_reason.c:88-94) and the single-threaded Go
+``Module.run`` ProcessFlow loop (pkg/module/metrics/metrics_module.go:283-303,
+the scaling bottleneck) — with jit-compiled vectorized kernels.
+"""
+
+from retina_tpu.ops.hashing import fmix32, hash_cols, hash_family, flow_key_hash64  # noqa: F401
+from retina_tpu.ops.countmin import CountMinSketch  # noqa: F401

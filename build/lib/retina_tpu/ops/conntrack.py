@@ -1,0 +1,302 @@
+"""Connection tracking and report sampling on device.
+
+Reference behavior (pkg/plugin/conntrack/_cprog/conntrack.c `ct_process_packet`
+:344, constants conntrack.h:21-29): a 262,144-entry LRU hash keyed by the
+5-tuple decides, per packet, whether to emit a flow report — always on
+SYN/FIN/RST, otherwise at most once per CT_REPORT_INTERVAL (30s) per
+connection — collapsing the per-packet firehose into per-connection reports.
+
+TPU re-design (v2 — sort-centric, pass-minimal): an LRU hash with per-packet
+pointer chasing is the opposite of what a vector unit wants, and so is a
+long chain of B-sized gathers/scatters (the measured cost on TPU is the
+*number of random-access passes*, not the compare math). So:
+
+- **one multi-operand bitonic sort** (`lax.sort`, num_keys=2) groups the
+  batch by connection fingerprint, carrying slot/attr/bytes payloads along
+  (bitonic networks vectorize on the VPU; a sort costs ~2 scatter passes);
+- **segmented associative scan** turns per-connection packet/byte totals
+  and the SYN/FIN/RST "interesting" flag into fused elementwise work;
+- the hash table is **two packed row-tables** — keys (S, 2) [fp_lo, fp_hi]
+  and values (S, 4) [meta, pkts, bytes, spare] — so resident state is TWO
+  row-gathers and the update is TWO row-scatters (vs 7 gathers + 9
+  scatters over scalar columns in v1);
+- `meta` packs last_seen (16-bit wrapping seconds), last_report (14-bit
+  wrapping seconds), an initiator-side bit and a TCP bit into one u32.
+  Wrap-aware deltas cover the reference lifetimes (<= 360 s) with margin;
+  a connection idle > 18 h can misread as fresh once — the same class of
+  degradation an LRU shows under pressure;
+- direct-mapped slots: collision = silent eviction (the LRU's pressure
+  behavior), zero control flow.
+
+Report decisions and update scatters happen on each connection's LAST row
+in sorted order; the original event index rides along as a sort payload so
+returned report masks/payloads are scattered back to ORIGINAL batch order
+(one extra row-scatter) — downstream consumers (low-aggregation sketch
+gating in models/pipeline.py, conntrack-sampled flow export) need report
+decisions aligned with the event columns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.ops.hashing import hash_cols, reduce_range
+from retina_tpu.events.schema import TCP_SYN, TCP_FIN, TCP_RST
+
+# Reference timeouts (conntrack.h:21-29), in seconds.
+CT_REPORT_INTERVAL = 30
+CT_TCP_LIFETIME = 360
+CT_NON_TCP_LIFETIME = 60
+DEFAULT_SLOTS = 1 << 18  # 262,144, matching the reference map size
+# Wrap-aware idle deltas read a FUTURE last_seen (feed thread stamped a
+# later second than the reader's clock — racy but legal across threads)
+# as ~0xFFFF idle. Deltas in the top slack band are clock skew, not
+# 18-hour idleness; treat them as fresh.
+CLOCK_SKEW_SLACK = 256
+
+
+def _seg_scan(first: jnp.ndarray, *values: jnp.ndarray):
+    """Segmented inclusive scans: within each run started by ``first``,
+    uint32 operands accumulate (sum) and bool operands OR. One fused
+    log-depth pass for all operands."""
+
+    def op(a, b):
+        af, avs = a[0], a[1:]
+        bf, bvs = b[0], b[1:]
+        outs = tuple(
+            jnp.where(bf, bv, (av | bv) if av.dtype == jnp.bool_ else av + bv)
+            for av, bv in zip(avs, bvs)
+        )
+        return (af | bf,) + outs
+
+    res = jax.lax.associative_scan(op, (first,) + values)
+    return res[1:]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ConntrackTable:
+    """Direct-mapped connection table, packed for row access.
+
+    keys: (S, 2) uint32 [fp_lo, fp_hi]; (0, 0) marks an empty slot.
+    vals: (S, 4) uint32 [meta, packets, bytes, spare] where meta =
+          seen16 | report14 << 16 | init_is_a << 30 | is_tcp << 31.
+    """
+
+    keys: jnp.ndarray
+    vals: jnp.ndarray
+    seed: int = 0
+
+    def tree_flatten(self):
+        return (self.keys, self.vals), (self.seed,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, seed=aux[0])
+
+    @classmethod
+    def zeros(cls, n_slots: int = DEFAULT_SLOTS, seed: int = 0) -> "ConntrackTable":
+        assert n_slots & (n_slots - 1) == 0
+        return cls(
+            keys=jnp.zeros((n_slots, 2), jnp.uint32),
+            vals=jnp.zeros((n_slots, 4), jnp.uint32),
+            seed=seed,
+        )
+
+    @property
+    def n_slots(self) -> int:
+        return int(self.keys.shape[0])
+
+    # Accumulator views (tests + gc accounting read these).
+    @property
+    def packets(self) -> jnp.ndarray:
+        return self.vals[:, 1]
+
+    @property
+    def bytes(self) -> jnp.ndarray:
+        return self.vals[:, 2]
+
+    def process(
+        self,
+        src_ip: jnp.ndarray,
+        dst_ip: jnp.ndarray,
+        ports: jnp.ndarray,
+        proto: jnp.ndarray,
+        tcp_flags: jnp.ndarray,
+        now_s: jnp.ndarray,
+        bytes_: jnp.ndarray,
+        mask: jnp.ndarray,
+        packets_: jnp.ndarray | None = None,
+    ) -> tuple["ConntrackTable", jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """One fused conntrack pass over a (B,) batch.
+
+        Returns (new_table, report_mask (B,) bool, is_reply (B,) bool,
+        report_packets (B,) u32, report_bytes (B,) u32) — aligned with the
+        INPUT batch order (each connection's report lands on its last
+        event row in the batch). Reporting rows carry the connection's
+        packet/byte totals accumulated since its previous report (the
+        reference's conntrackmetadata payload, conntrack.c:15-31)
+        including this batch's contribution, and those slots' accumulators
+        then reset. ``now_s`` is the batch timestamp (scalar or
+        broadcastable). ``packets_`` is the per-event packet count column
+        for pre-aggregated sources (F.PACKETS); None counts each event
+        row as one packet (the reference's per-packet kernel view).
+        """
+        s = self.n_slots
+        # Order-independent key: same connection regardless of direction;
+        # ports break the tie for hairpin flows where src_ip == dst_ip.
+        sp = ports >> 16
+        dp = ports & jnp.uint32(0xFFFF)
+        fwd_order = (src_ip < dst_ip) | ((src_ip == dst_ip) & (sp <= dp))
+        a_ip = jnp.where(fwd_order, src_ip, dst_ip)
+        b_ip = jnp.where(fwd_order, dst_ip, src_ip)
+        a_pt = jnp.where(fwd_order, sp, dp)
+        b_pt = jnp.where(fwd_order, dp, sp)
+        key_cols = [a_ip, b_ip, (a_pt << 16) | b_pt, proto]
+        fp_lo = hash_cols(key_cols, np.uint32(self.seed) * 2 + 0xC7)
+        fp_hi = hash_cols(key_cols, np.uint32(self.seed) * 2 + 0xC8)
+        slot = reduce_range(fp_lo ^ fp_hi, s)
+
+        # Masked rows sort to the end (max key) and carry a cleared mask bit.
+        k_lo = jnp.where(mask, fp_lo, jnp.uint32(0xFFFFFFFF))
+        k_hi = jnp.where(mask, fp_hi, jnp.uint32(0xFFFFFFFF))
+        is_tcp_ev = proto == jnp.uint32(6)
+        interesting = (tcp_flags & jnp.uint32(TCP_SYN | TCP_FIN | TCP_RST)) > 0
+        # attr: flags(0-7) | tcp(8) | src_is_a(9) | mask(10) | interesting(11)
+        attr = (
+            (tcp_flags & jnp.uint32(0xFF))
+            | (is_tcp_ev.astype(jnp.uint32) << 8)
+            | (fwd_order.astype(jnp.uint32) << 9)
+            | (mask.astype(jnp.uint32) << 10)
+            | (interesting.astype(jnp.uint32) << 11)
+        )
+        b = src_ip.shape[0]
+        if packets_ is None:
+            packets_ = jnp.ones((b,), jnp.uint32)
+        sk_lo, sk_hi, s_slot, s_attr, s_bytes, s_pkts, s_idx = jax.lax.sort(
+            (
+                k_lo,
+                k_hi,
+                slot,
+                attr,
+                jnp.where(mask, bytes_, 0),
+                jnp.where(mask, packets_, 0),
+                jnp.arange(b, dtype=jnp.uint32),
+            ),
+            num_keys=2,
+        )
+        s_mask = ((s_attr >> 10) & 1).astype(bool)
+        s_int = ((s_attr >> 11) & 1).astype(bool)
+        s_tcp = ((s_attr >> 8) & 1).astype(bool)
+        s_src_is_a = ((s_attr >> 9) & 1).astype(bool)
+
+        diff = (sk_lo[1:] != sk_lo[:-1]) | (sk_hi[1:] != sk_hi[:-1])
+        first = jnp.concatenate([jnp.array([True]), diff])
+        last = jnp.concatenate([diff, jnp.array([True])]) & s_mask
+
+        seg_pkts, seg_bytes, seg_int = _seg_scan(first, s_pkts, s_bytes, s_int)
+
+        # ---- resident slot state: two row-gathers ----
+        gi = s_slot.astype(jnp.int32)
+        krow = self.keys[gi]  # (B, 2)
+        vrow = self.vals[gi]  # (B, 4)
+        same_conn = (krow[:, 0] == sk_lo) & (krow[:, 1] == sk_hi)
+        meta = vrow[:, 0]
+        seen16 = meta & jnp.uint32(0xFFFF)
+        rep14 = (meta >> 16) & jnp.uint32(0x3FFF)
+        init_a = ((meta >> 30) & 1).astype(bool)
+
+        now16 = (now_s & jnp.uint32(0xFFFF)).astype(jnp.uint32)
+        now14 = (now_s & jnp.uint32(0x3FFF)).astype(jnp.uint32)
+        lifetime = jnp.where(
+            s_tcp, jnp.uint32(CT_TCP_LIFETIME), jnp.uint32(CT_NON_TCP_LIFETIME)
+        )
+        idle = (now16 - seen16) & jnp.uint32(0xFFFF)
+        expired = (idle > lifetime) & (
+            idle <= jnp.uint32(0xFFFF - CLOCK_SKEW_SLACK)
+        )
+        is_new = (~same_conn) | expired
+        rep_delta = (now14 - rep14) & jnp.uint32(0x3FFF)
+        interval_up = (rep_delta >= jnp.uint32(CT_REPORT_INTERVAL)) & (
+            rep_delta <= jnp.uint32(0x3FFF - CLOCK_SKEW_SLACK)
+        )
+        report = last & (seg_int | is_new | (same_conn & interval_up))
+        is_reply = s_mask & same_conn & (~expired) & (init_a != s_src_is_a)
+
+        # New/expired connections must not inherit the evicted resident's
+        # accumulators in their payload (the stale slot counts belong to a
+        # different 5-tuple).
+        res_pkts = jnp.where(is_new, 0, vrow[:, 1])
+        res_bytes = jnp.where(is_new, 0, vrow[:, 2])
+        report_packets = jnp.where(report, res_pkts + seg_pkts, 0).astype(
+            jnp.uint32
+        )
+        report_bytes = jnp.where(report, res_bytes + seg_bytes, 0).astype(
+            jnp.uint32
+        )
+
+        # ---- update rows (last row per connection): two row-scatters ----
+        new_meta = (
+            now16
+            | (jnp.where(report, now14, rep14) << 16)
+            | (jnp.where(is_new, s_src_is_a, init_a).astype(jnp.uint32) << 30)
+            | (s_tcp.astype(jnp.uint32) << 31)
+        )
+        acc_pkts = jnp.where(report, 0, res_pkts + seg_pkts)
+        acc_bytes = jnp.where(report, 0, res_bytes + seg_bytes)
+        eff = jnp.where(last, s_slot, jnp.uint32(s))
+        new_keys = self.keys.at[eff].set(
+            jnp.stack([sk_lo, sk_hi], axis=1), mode="drop"
+        )
+        new_vals = self.vals.at[eff].set(
+            jnp.stack(
+                [new_meta, acc_pkts, acc_bytes, jnp.zeros_like(new_meta)], axis=1
+            ),
+            mode="drop",
+        )
+        new = dataclasses.replace(self, keys=new_keys, vals=new_vals)
+
+        # Scatter decisions back to original batch positions (one (B, 4)
+        # row-scatter): downstream gating needs alignment with the event
+        # columns, not the sort order.
+        packed = jnp.stack(
+            [
+                report.astype(jnp.uint32),
+                is_reply.astype(jnp.uint32),
+                report_packets,
+                report_bytes,
+            ],
+            axis=1,
+        )
+        orig = jnp.zeros((b, 4), jnp.uint32).at[s_idx.astype(jnp.int32)].set(
+            packed
+        )
+        return (
+            new,
+            orig[:, 0].astype(bool),
+            orig[:, 1].astype(bool),
+            orig[:, 2],
+            orig[:, 3],
+        )
+
+    def active_connections(self, now_s: int) -> jnp.ndarray:
+        """Count of non-expired resident connections (scrape-time gauge).
+
+        Uses the same per-protocol lifetimes as process()'s expiry rule.
+        """
+        live = (self.keys[:, 0] | self.keys[:, 1]) != 0
+        meta = self.vals[:, 0]
+        seen16 = meta & jnp.uint32(0xFFFF)
+        is_tcp = (meta >> 31) > 0
+        lifetime = jnp.where(
+            is_tcp, jnp.uint32(CT_TCP_LIFETIME), jnp.uint32(CT_NON_TCP_LIFETIME)
+        )
+        idle = (jnp.uint32(now_s) - seen16) & jnp.uint32(0xFFFF)
+        fresh = (idle <= lifetime) | (
+            idle > jnp.uint32(0xFFFF - CLOCK_SKEW_SLACK)
+        )
+        return jnp.sum(live & fresh)

@@ -1,0 +1,124 @@
+"""Count-Min sketch on device.
+
+Replaces the reference's exact hash-map aggregation (kernel per-CPU hash
+maps, drop_reason.c:88-94, and the Go GaugeVec label-map updates in
+pkg/module/metrics/forward.go:97-171) with a fixed-memory, mergeable,
+vectorized counter summary.
+
+State is a plain pytree (depth, width) so it jits, shards, and merges with
+``psum`` over ICI — the cross-chip merge the reference performs via
+Prometheus scrape-side aggregation (SURVEY.md §2.6).
+
+Update strategy: one scatter-add per sketch row. XLA lowers scatter on TPU
+via a sort-based path; rows are independent so the D scatters are batched
+into a single scatter on a (D, W) table with row-offset-adjusted indices,
+giving the compiler one big op to schedule instead of D small ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.ops.hashing import hash_cols, reduce_range
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class CountMinSketch:
+    """Plain Count-Min: table (depth, width) uint32/float32 counts.
+
+    depth d, width w give overestimate error <= e/w * N with prob 1 - e^-d
+    on point queries (N = total inserted weight). Plain update (add to all
+    rows), not conservative update: conservative update's read-modify-max
+    is not associative under the duplicate keys a vectorized batch carries,
+    so it cannot be expressed as one scatter — size width for the plain
+    bound.
+    """
+
+    table: jnp.ndarray  # (depth, width)
+    seed: int = 0
+
+    # -- pytree plumbing -----------------------------------------------------
+    def tree_flatten(self):
+        return (self.table,), (self.seed,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(table=children[0], seed=aux[0])
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def zeros(
+        cls, depth: int = 4, width: int = 1 << 15, seed: int = 0, dtype=jnp.uint32
+    ) -> "CountMinSketch":
+        assert width & (width - 1) == 0, "width must be a power of two"
+        return cls(table=jnp.zeros((depth, width), dtype), seed=seed)
+
+    @property
+    def depth(self) -> int:
+        return int(self.table.shape[0])
+
+    @property
+    def width(self) -> int:
+        return int(self.table.shape[1])
+
+    # -- kernel --------------------------------------------------------------
+    def _indices(self, key_cols: list[jnp.ndarray]) -> jnp.ndarray:
+        """(B,) key columns -> (depth, B) table column indices."""
+        seeds = (
+            np.arange(1, self.depth + 1, dtype=np.uint32) + np.uint32(self.seed)
+        ).reshape(self.depth, 1)
+        h = hash_cols([c[None, :] for c in key_cols], seeds)  # (depth, B)
+        return reduce_range(h, self.width)
+
+    def update(
+        self, key_cols: list[jnp.ndarray], weights: jnp.ndarray
+    ) -> "CountMinSketch":
+        """Add ``weights`` (masked rows must carry weight 0) at the keys.
+
+        Flattens the (depth, width) table and scatter-adds all depth rows in
+        one op: index for row d is d*width + h_d(key).
+        """
+        d, w = self.table.shape
+        cols = self._indices(key_cols)  # (d, B)
+        flat_idx = (
+            cols + (jnp.arange(d, dtype=jnp.uint32) * jnp.uint32(w))[:, None]
+        ).reshape(-1)
+        wts = jnp.broadcast_to(weights.astype(self.table.dtype), cols.shape[1:])
+        flat_wts = jnp.broadcast_to(wts[None, :], cols.shape).reshape(-1)
+        new_flat = (
+            self.table.reshape(-1)
+            .at[flat_idx]
+            .add(flat_wts, mode="drop", unique_indices=False)
+        )
+        return dataclasses.replace(self, table=new_flat.reshape(d, w))
+
+    def query(self, key_cols: list[jnp.ndarray]) -> jnp.ndarray:
+        """Point-estimate counts for (B,) keys: min over depth rows."""
+        cols = self._indices(key_cols)  # (d, B)
+        vals = jnp.take_along_axis(self.table, cols.astype(jnp.int32), axis=1)
+        return jnp.min(vals, axis=0)
+
+    def merge(self, other: "CountMinSketch") -> "CountMinSketch":
+        """CMS merge = elementwise add (the psum-able operation)."""
+        return dataclasses.replace(self, table=self.table + other.table)
+
+    def reset(self) -> "CountMinSketch":
+        return dataclasses.replace(self, table=jnp.zeros_like(self.table))
+
+    def total(self) -> jnp.ndarray:
+        """Total inserted weight (row 0 sum — every row sums to N)."""
+        return jnp.sum(self.table[0])
+
+
+@partial(jax.jit, donate_argnums=0)
+def cms_update_jit(
+    sketch: CountMinSketch, key_cols: list[jnp.ndarray], weights: jnp.ndarray
+) -> CountMinSketch:
+    """Standalone jitted update (donates the old table buffer)."""
+    return sketch.update(key_cols, weights)

@@ -1,0 +1,151 @@
+"""Streaming entropy estimation over hashed histograms.
+
+DDoS/port-scan detection via distributional shift: a volumetric DDoS
+collapses dst-IP entropy and spikes src-IP entropy; a port scan spikes
+dst-port entropy. The reference has no entropy pipeline — anomaly-style
+signal there is the drop/flags metric family (pkg/module/metrics/drops.go,
+tcpflags.go); BASELINE config 4 makes entropy a first-class detector here.
+
+Method: count-sketch histogram of the keyed quantity into K buckets per
+window, plug-in (maximum-likelihood) entropy of the bucket distribution.
+Hash-bucketing biases entropy down by at most log-collisions; with
+K >> active keys the bias is small, and the *change* signal (EWMA z-score)
+is what the detector thresholds on. Histogram merge across chips = psum,
+then entropy computed on the merged histogram — so the estimate is exactly
+the single-chip estimate of the union stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.ops.hashing import hash_cols, reduce_range
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class EntropyWindow:
+    """Bank of G hashed histograms, (G, K) float32 counts for one window."""
+
+    counts: jnp.ndarray  # (G, K)
+    seed: int = 0
+
+    def tree_flatten(self):
+        return (self.counts,), (self.seed,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(counts=children[0], seed=aux[0])
+
+    @classmethod
+    def zeros(cls, n_groups: int = 1, n_buckets: int = 1 << 12, seed: int = 0):
+        return cls(counts=jnp.zeros((n_groups, n_buckets), jnp.float32), seed=seed)
+
+    @property
+    def n_buckets(self) -> int:
+        return int(self.counts.shape[1])
+
+    def update(
+        self,
+        key_cols: list[jnp.ndarray],
+        group: jnp.ndarray,
+        weights: jnp.ndarray,
+    ) -> "EntropyWindow":
+        g, k = self.counts.shape
+        h = hash_cols(key_cols, np.uint32(0xE17209) + np.uint32(self.seed))
+        idx = reduce_range(h, k)
+        flat_idx = group.astype(jnp.uint32) * jnp.uint32(k) + idx
+        new_flat = (
+            self.counts.reshape(-1)
+            .at[flat_idx]
+            .add(weights.astype(jnp.float32), mode="drop")
+        )
+        return dataclasses.replace(self, counts=new_flat.reshape(g, k))
+
+    def entropy_bits(self) -> jnp.ndarray:
+        """(G,) plug-in Shannon entropy in bits of each histogram."""
+        n = jnp.sum(self.counts, axis=1, keepdims=True)
+        p = self.counts / jnp.maximum(n, 1.0)
+        h = -jnp.sum(jnp.where(p > 0, p * jnp.log2(jnp.maximum(p, 1e-30)), 0.0), axis=1)
+        return h
+
+    def merge(self, other: "EntropyWindow") -> "EntropyWindow":
+        return dataclasses.replace(self, counts=self.counts + other.counts)
+
+    def reset(self) -> "EntropyWindow":
+        return dataclasses.replace(self, counts=jnp.zeros_like(self.counts))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class AnomalyEWMA:
+    """Per-group EWMA + variance tracker for entropy z-score anomaly flags.
+
+    State update is pure (jit/scan friendly); the detector flags when
+    |h - mean| > z_thresh * std after a warmup of min_windows observations.
+    """
+
+    mean: jnp.ndarray  # (G,)
+    var: jnp.ndarray  # (G,)
+    n_obs: jnp.ndarray  # (G,) windows observed
+    alpha: float = 0.1
+
+    def tree_flatten(self):
+        return (self.mean, self.var, self.n_obs), (self.alpha,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(mean=children[0], var=children[1], n_obs=children[2], alpha=aux[0])
+
+    @classmethod
+    def zeros(cls, n_groups: int = 1, alpha: float = 0.1) -> "AnomalyEWMA":
+        # Distinct buffers (a shared array would break jit donation).
+        z = lambda: jnp.zeros((n_groups,), jnp.float32)
+        return cls(mean=z(), var=z(), n_obs=z(), alpha=alpha)
+
+    def observe(
+        self,
+        h: jnp.ndarray,
+        z_thresh: float = 4.0,
+        min_windows: int = 10,
+        active: jnp.ndarray | bool = True,
+    ) -> tuple["AnomalyEWMA", jnp.ndarray, jnp.ndarray]:
+        """Returns (new_state, anomaly_flags (G,) bool, z_scores (G,)).
+
+        ``active`` (scalar or (G,) bool) marks windows that actually saw
+        traffic. Idle windows are SKIPPED entirely — no flag, no
+        baseline update, no warmup credit: an agent idling on a quiet
+        node must not train a zero-entropy baseline that (a) flags the
+        first real traffic as an attack and (b) makes a genuine
+        single-source flood look normal."""
+        active = jnp.broadcast_to(jnp.asarray(active, bool), h.shape)
+        warm = self.n_obs >= min_windows
+        std = jnp.sqrt(jnp.maximum(self.var, 1e-12))
+        z = jnp.where(
+            warm & active, (h - self.mean) / jnp.maximum(std, 1e-3), 0.0
+        )
+        flag = warm & active & (jnp.abs(z) > z_thresh)
+        # Do not absorb anomalous windows into the baseline (else a sustained
+        # attack trains the detector to call it normal). First observation
+        # seeds the mean outright — otherwise the zero-start transient
+        # pollutes the variance for tens of windows.
+        first = self.n_obs == 0
+        a = jnp.where(
+            flag | ~active, 0.0, jnp.where(first, 1.0, self.alpha)
+        )
+        delta = h - self.mean
+        new_mean = self.mean + a * delta
+        new_var = jnp.where(first & active, 0.0,
+                            (1 - a) * (self.var + a * delta * delta))
+        return (
+            dataclasses.replace(
+                self, mean=new_mean, var=new_var,
+                n_obs=self.n_obs + active.astype(self.n_obs.dtype),
+            ),
+            flag,
+            z,
+        )

@@ -1,0 +1,123 @@
+"""HyperLogLog cardinality sketch on device.
+
+Fills the role of the reference's distinct-counting label sets (e.g.
+per-(drop reason, pod) distinct sources, and the telemetry heartbeat's
+metrics-cardinality self-report, pkg/telemetry/telemetry.go:196-258) with a
+fixed-memory mergeable estimator.
+
+Register update is max(), so the cross-chip merge is an elementwise
+jnp.maximum under shard_map — the HLL analog of the CMS psum.
+
+Supports **vectorized multi-sketch** operation: a (G, M) register bank holds
+G independent HLLs (one per label group, e.g. per drop reason), updated in
+one scatter-max. That replaces the reference's per-label-pair map entries
+with a dense rectangle the TPU likes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.ops.hashing import hash_cols, reduce_range
+
+
+def _alpha(m: int) -> float:
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1.0 + 1.079 / m)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class HyperLogLog:
+    """Bank of G HLL sketches with M = 2^p registers each.
+
+    registers: (G, M) uint32 (values 0..32; uint32 to keep scatter dtypes
+    uniform with the other sketches).
+    """
+
+    registers: jnp.ndarray
+    seed: int = 0
+
+    def tree_flatten(self):
+        return (self.registers,), (self.seed,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(registers=children[0], seed=aux[0])
+
+    @classmethod
+    def zeros(cls, n_groups: int = 1, precision: int = 12, seed: int = 0) -> "HyperLogLog":
+        m = 1 << precision
+        return cls(registers=jnp.zeros((n_groups, m), jnp.uint32), seed=seed)
+
+    @property
+    def n_groups(self) -> int:
+        return int(self.registers.shape[0])
+
+    @property
+    def m(self) -> int:
+        return int(self.registers.shape[1])
+
+    def update(
+        self,
+        key_cols: list[jnp.ndarray],
+        group: jnp.ndarray,
+        mask: jnp.ndarray,
+    ) -> "HyperLogLog":
+        """Observe (B,) keys in (B,) group slots; mask out padding rows.
+
+        rho (leading-zero rank) comes from the hash bits not used for the
+        register index. Masked rows are routed to rho=0 which never lowers
+        a register (scatter-max with 0 is a no-op).
+        """
+        g, m = self.registers.shape
+        h = hash_cols(key_cols, np.uint32(0xC0FFEE) + np.uint32(self.seed))
+        idx = reduce_range(h, m)  # low bits -> register index
+        # rank of the remaining 32 - p bits: position of first set bit + 1.
+        p = int(m).bit_length() - 1
+        rest = h >> np.uint32(p)
+        nbits = 32 - p
+        # rho = nbits - floor(log2(rest)) for rest>0 else nbits+1. Exact
+        # integer math (float32 log2 is off by one at rest = 2^k - 1 for
+        # k >= 23): fold bits below the MSB, then floor(log2) = popcount - 1.
+        folded = rest
+        for shift in (1, 2, 4, 8, 16):
+            folded = folded | (folded >> shift)
+        hsb = jax.lax.population_count(folded).astype(jnp.int32) - 1  # -1 if rest==0
+        rho = (nbits - hsb).astype(jnp.uint32)
+        rho = jnp.where(mask, rho, jnp.uint32(0))
+        flat_idx = group.astype(jnp.uint32) * jnp.uint32(m) + idx
+        new_flat = (
+            self.registers.reshape(-1)
+            .at[flat_idx]
+            .max(rho, mode="drop", unique_indices=False)
+        )
+        return dataclasses.replace(self, registers=new_flat.reshape(g, m))
+
+    def estimate(self) -> jnp.ndarray:
+        """(G,) cardinality estimates with small-range correction."""
+        m = self.m
+        regs = self.registers.astype(jnp.float32)
+        raw = _alpha(m) * m * m / jnp.sum(jnp.exp2(-regs), axis=1)
+        zeros = jnp.sum(self.registers == 0, axis=1).astype(jnp.float32)
+        # Linear counting when estimate is small and there are empty registers.
+        lc = m * jnp.log(m / jnp.maximum(zeros, 1e-9))
+        use_lc = (raw <= 2.5 * m) & (zeros > 0)
+        return jnp.where(use_lc, lc, raw)
+
+    def merge(self, other: "HyperLogLog") -> "HyperLogLog":
+        return dataclasses.replace(
+            self, registers=jnp.maximum(self.registers, other.registers)
+        )
+
+    def reset(self) -> "HyperLogLog":
+        return dataclasses.replace(self, registers=jnp.zeros_like(self.registers))

@@ -1,0 +1,151 @@
+"""Heavy-hitter candidate tracking on device.
+
+The reference reports per-key metrics through unbounded Prometheus label
+maps (remote-context mode is explicitly unbounded, SURVEY.md §2.3 and
+docs/03-Metrics/modes/modes.md) — the design whose CPU/cardinality cost the
+TPU backend exists to remove. Here per-key reporting is **top-k over a
+CMS-backed candidate table**:
+
+- the CMS absorbs every event (no key state growth);
+- a fixed-size slot table tracks the current best key per hash slot with
+  its CMS-estimated count;
+- at scrape time the host reads S slots (tiny transfer) and takes top-k.
+
+Exact top-k maintenance is inherently sequential (SpaceSaving); this slot
+scheme is its vectorization-friendly relaxation: per batch, each slot keeps
+the highest-estimate key that hashed into it. Recall loss only happens when
+two true heavy hitters collide in a slot, so S is sized ~16-64x over k.
+
+The slot update uses an associative two-pass trick so it is one scatter-max
+plus column scatters (no sequential loop, no sort):
+  1. scatter-max the estimates into slot counts;
+  2. re-gather: rows whose estimate equals the new slot count are winners
+     and overwrite the slot's key columns (ties carry equal counts, so
+     either key is a valid candidate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.ops.hashing import hash_cols, reduce_range
+from retina_tpu.ops.countmin import CountMinSketch
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class TopKTable:
+    """Candidate table: (S, C) key rows + (S,) estimated counts.
+
+    Keys are row-major so the winner write is ONE (B, C) row-scatter
+    (contiguous minor dim = one line per winning event) instead of C
+    separate column scatters."""
+
+    key_rows: jnp.ndarray  # (S, C) uint32
+    counts: jnp.ndarray  # (S,) uint32
+    seed: int = 0
+
+    def tree_flatten(self):
+        return (self.key_rows, self.counts), (self.seed,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(key_rows=children[0], counts=children[1], seed=aux[0])
+
+    @classmethod
+    def zeros(cls, n_key_cols: int, n_slots: int = 1 << 11, seed: int = 0):
+        assert n_slots & (n_slots - 1) == 0
+        return cls(
+            key_rows=jnp.zeros((n_slots, n_key_cols), jnp.uint32),
+            counts=jnp.zeros((n_slots,), jnp.uint32),
+            seed=seed,
+        )
+
+    @property
+    def n_slots(self) -> int:
+        return int(self.counts.shape[0])
+
+    def update(
+        self, key_cols: list[jnp.ndarray], estimates: jnp.ndarray
+    ) -> "TopKTable":
+        """Offer (B,) keys with CMS ``estimates`` (0 for masked rows)."""
+        s = self.n_slots
+        slot = reduce_range(
+            hash_cols(key_cols, np.uint32(0x70CC) + np.uint32(self.seed)), s
+        )
+        est = estimates.astype(jnp.uint32)
+        new_counts = self.counts.at[slot].max(est, mode="drop")
+        slot_now = new_counts[slot.astype(jnp.int32)]
+        # Winner rows: their estimate equals the slot's post-max count.
+        # est>0 excludes padding rows (their estimate is forced to 0).
+        win = (est == slot_now) & (est > 0)
+        safe_slot = jnp.where(win, slot, jnp.uint32(s))  # OOB rows dropped
+        rows = jnp.stack(key_cols, axis=1).astype(jnp.uint32)  # (B, C)
+        new_keys = self.key_rows.at[safe_slot].set(rows, mode="drop")
+        # Winning lanes with equal estimates may race, but all carry valid
+        # keys of equal count — either is a correct candidate.
+        return dataclasses.replace(self, key_rows=new_keys, counts=new_counts)
+
+    def top_k_host(self, k: int) -> tuple[np.ndarray, np.ndarray]:
+        """Host-side reconciliation: returns (keys (k, C), counts (k,)).
+
+        Reads the whole table (S rows — a few KB) and sorts on host; this is
+        the scrape-time path, off the device hot loop.
+        """
+        counts = np.asarray(self.counts)
+        keys = np.asarray(self.key_rows)  # (S, C)
+        order = np.argsort(counts)[::-1][:k]
+        sel = counts[order] > 0
+        return keys[order][sel], counts[order][sel]
+
+    def reset(self) -> "TopKTable":
+        return dataclasses.replace(
+            self,
+            key_rows=jnp.zeros_like(self.key_rows),
+            counts=jnp.zeros_like(self.counts),
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class HeavyHitterSketch:
+    """CMS + candidate table glued into one streaming top-k tracker."""
+
+    cms: CountMinSketch
+    table: TopKTable
+
+    def tree_flatten(self):
+        return (self.cms, self.table), ()
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(cms=children[0], table=children[1])
+
+    @classmethod
+    def zeros(
+        cls,
+        n_key_cols: int,
+        depth: int = 4,
+        width: int = 1 << 15,
+        n_slots: int = 1 << 11,
+        seed: int = 0,
+    ) -> "HeavyHitterSketch":
+        return cls(
+            cms=CountMinSketch.zeros(depth, width, seed=seed),
+            table=TopKTable.zeros(n_key_cols, n_slots, seed=seed),
+        )
+
+    def update(
+        self, key_cols: list[jnp.ndarray], weights: jnp.ndarray
+    ) -> "HeavyHitterSketch":
+        cms = self.cms.update(key_cols, weights)
+        est = cms.query(key_cols)
+        est = jnp.where(weights > 0, est, 0)
+        return HeavyHitterSketch(cms=cms, table=self.table.update(key_cols, est))
+
+    def reset(self) -> "HeavyHitterSketch":
+        return HeavyHitterSketch(cms=self.cms.reset(), table=self.table.reset())

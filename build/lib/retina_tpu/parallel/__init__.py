@@ -1,0 +1,17 @@
+"""Mesh, partitioning, and collective merges (multi-chip scale-out).
+
+Reference analog: SURVEY.md §2.6 — N independent agents + Prometheus
+scrape-merge + Hubble relay become one device mesh running the fused
+pipeline per-shard with psum/pmax/all_gather merges over ICI/DCN.
+"""
+
+from retina_tpu.parallel.mesh import make_mesh  # noqa: F401
+from retina_tpu.parallel.partition import (  # noqa: F401
+    ShardedBatch,
+    canonical_conn_hash,
+    partition_events,
+)
+from retina_tpu.parallel.telemetry import (  # noqa: F401
+    ShardedTelemetry,
+    topk_from_snapshot,
+)

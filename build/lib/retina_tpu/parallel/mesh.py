@@ -1,0 +1,44 @@
+"""Device mesh construction for sharded telemetry.
+
+Reference analog (SURVEY.md §2.6): the reference scales by running N
+independent node agents whose metrics are merged at Prometheus-scrape time,
+and ships cluster-wide flows over the Hubble relay. The TPU-native design
+replaces both with a **device mesh**: events are hash-partitioned across
+chips, every chip runs the identical fused pipeline step, and merges ride
+XLA collectives — `psum` over ICI within a slice, and over DCN between
+hosts when the mesh spans multiple processes (jax.distributed).
+
+Mesh shapes:
+- single host, N chips:           1-D mesh  ("chip",)
+- multi-host slice/cluster:       2-D mesh  ("node", "chip") — collectives
+  over the ("node", "chip") tuple reduce over ICI first, then DCN, which is
+  exactly the hierarchy the reference's scrape/relay topology implies.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    devices: Sequence[jax.Device] | None = None,
+    n_nodes: int | None = None,
+) -> Mesh:
+    """Build the telemetry mesh over ``devices`` (default: all).
+
+    With ``n_nodes`` set, returns a 2-D ("node", "chip") mesh — the shape
+    used for cross-node service-graph export (BASELINE config 5, v5e-8 as
+    8 "nodes"). Otherwise a 1-D ("chip",) mesh.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_nodes is not None:
+        assert len(devs) % n_nodes == 0, (
+            f"{len(devs)} devices do not split into {n_nodes} nodes"
+        )
+        per = len(devs) // n_nodes
+        return Mesh(np.array(devs).reshape(n_nodes, per), ("node", "chip"))
+    return Mesh(np.array(devs), ("chip",))

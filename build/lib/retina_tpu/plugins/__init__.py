@@ -1,0 +1,45 @@
+"""Data-plane plugins (reference pkg/plugin, SURVEY.md §2.2).
+
+Importing this package registers every platform-supported plugin with the
+registry (the reference's ``init()`` + ``registry.Add`` self-registration,
+registry.go:42-47).
+"""
+
+import sys
+
+from retina_tpu.plugins import registry
+from retina_tpu.plugins.api import (
+    EventSink,
+    Plugin,
+    QueueSink,
+    UnsupportedPlatform,
+)
+
+# Self-registration imports (each module calls registry.add at import).
+from retina_tpu.plugins import (  # noqa: F401
+    ciliumeventobserver,
+    conntrack_gc,
+    dns,
+    dropreason,
+    externalevents,
+    infiniband,
+    linuxutil,
+    mockplugin,
+    packetforward,
+    packetparser,
+    tcpretrans,
+)
+
+# Registered on every platform: the collector/parser logic is
+# cross-platform (and tested on Linux via injected sources); only the
+# default OS sources are win32-gated, raising UnsupportedPlatform from
+# init() elsewhere — which pluginmanager contains.
+from retina_tpu.plugins import windows  # noqa: E402,F401
+
+__all__ = [
+    "EventSink",
+    "Plugin",
+    "QueueSink",
+    "UnsupportedPlatform",
+    "registry",
+]

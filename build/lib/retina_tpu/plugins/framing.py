@@ -1,0 +1,84 @@
+"""Shared record-frame wire format.
+
+One framing for every socket-based record producer/consumer in the tree
+(externalevents server, pktmon client): little-endian u32 length prefix,
+then a msgpack doc ``{"records": <bytes of (N,16) uint32 le>,
+"dns_names": {hash: name}}``. Extracted so the two consumers cannot
+drift (endianness, caps, dns_names handling).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable
+
+import msgpack
+import numpy as np
+
+from retina_tpu.events.schema import NUM_FIELDS
+
+MAX_FRAME = 64 << 20
+
+
+def send_frame(sock: socket.socket, records: np.ndarray,
+               dns_names: dict[int, str] | None = None) -> None:
+    """Producer-side helper: ship a record block."""
+    payload = msgpack.packb(
+        {
+            "records": np.ascontiguousarray(records, np.uint32).tobytes(),
+            "dns_names": dns_names or {},
+        }
+    )
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def decode_record_frame(frame: bytes) -> tuple[np.ndarray, dict[int, str]]:
+    """Frame payload → ((N, 16) uint32 records, dns_names). Raises on a
+    malformed frame; callers count the loss."""
+    doc = msgpack.unpackb(frame, strict_map_key=False)
+    rec = np.frombuffer(doc["records"], np.uint32).reshape(
+        -1, NUM_FIELDS).copy()
+    return rec, dict(doc.get("dns_names") or {})
+
+
+def read_frames(
+    conn: socket.socket,
+    stop: threading.Event,
+    on_frame: Callable[[bytes], None],
+    log,
+) -> None:
+    """Drain frames from a connected socket until EOF, error, stop, or an
+    oversized frame (which poisons the length stream — the connection is
+    abandoned, as the reference drops a desynced monitor socket)."""
+    buf = b""
+    while not stop.is_set():
+        try:
+            chunk = conn.recv(1 << 20)
+        except (TimeoutError, socket.timeout):
+            continue
+        except OSError:
+            return
+        if not chunk:
+            return
+        buf += chunk
+        while len(buf) >= 4:
+            (n,) = struct.unpack_from("<I", buf)
+            if n > MAX_FRAME:
+                log.error("frame too large (%d bytes); dropping conn", n)
+                return
+            if len(buf) < 4 + n:
+                break
+            frame, buf = buf[4:4 + n], buf[4 + n:]
+            on_frame(frame)
+
+
+def publish_dns_names(names: dict[int, str]) -> None:
+    """Feed decoded qname strings to the DNS plugin's string table."""
+    if not names:
+        return
+    from retina_tpu.plugins.dns import TOPIC_DNS_NAMES
+    from retina_tpu.pubsub import get_pubsub
+
+    get_pubsub().publish(TOPIC_DNS_NAMES, dict(names))

@@ -1,0 +1,347 @@
+"""Interactive debug shell subsystem.
+
+Reference analog: shell/ (shell.go, manifests.go, attach.go,
+validation.go — 395 LoC) behind ``kubectl retina shell``:
+
+- ``RunInPod`` (shell.go:28): inject an ephemeral debug container into a
+  target pod (capabilities dropped to ALL-minus-requested), wait until
+  running, attach a TTY.
+- ``RunInNode`` (shell.go:67): create a host-network debug pod pinned to
+  the node (tolerates everything, optional host filesystem mount at
+  /host, optional hostPID), attach, delete on exit.
+- validation.go: refuse non-Linux nodes.
+
+Here the manifest builders are pure dict constructors (manifests.go
+analog, testable without a cluster), the apiserver traffic rides the
+shared :class:`~retina_tpu.operator.kubeclient.KubeClient`, and the TTY
+attach — a SPDY/websocket protocol the reference gets from
+client-go — is delegated to ``kubectl attach`` (seam-injectable for
+tests). Without a kubeconfig the command degrades to a LOCAL diagnostic
+shell: tool inventory, agent status banner, RETINA_* environment, then
+exec of the user's shell — the single-host analog of the node debug pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import shutil
+import string
+import subprocess
+import sys
+import time
+import urllib.request
+from typing import Callable, Optional
+
+from retina_tpu.operator.kubeclient import KubeClient
+
+CORE_V1 = "/api/v1"
+DEFAULT_IMAGE = "ghcr.io/retina-tpu/retina-shell:latest"
+# Diagnostic tools the debug image ships (the retina-shell image's
+# toolset); locally we report which are present.
+SHELL_TOOLS = ("tcpdump", "ss", "ip", "conntrack", "curl", "dig",
+               "traceroute", "jq")
+
+
+@dataclasses.dataclass
+class ShellConfig:
+    """shell.go:15-26 Config."""
+
+    image: str = DEFAULT_IMAGE
+    host_pid: bool = False
+    capabilities: tuple[str, ...] = ()  # e.g. ("NET_ADMIN", "NET_RAW")
+    timeout_s: float = 60.0
+    # Host filesystem access applies only to nodes, not pods.
+    mount_host_filesystem: bool = False
+    allow_host_filesystem_write: bool = False
+
+
+def _rand_name() -> str:
+    suffix = "".join(random.choices(string.ascii_lowercase + string.digits,
+                                    k=5))
+    return f"retina-shell-{suffix}"
+
+
+# -- manifest builders (manifests.go) ----------------------------------
+def ephemeral_container_for_pod_debug(cfg: ShellConfig) -> dict:
+    """manifests.go:10-25: caps drop ALL, add only what was asked."""
+    return {
+        "name": _rand_name(),
+        "image": cfg.image,
+        "stdin": True,
+        "tty": True,
+        "securityContext": {
+            "capabilities": {
+                "drop": ["ALL"],
+                "add": list(cfg.capabilities),
+            },
+        },
+    }
+
+
+def host_network_pod_for_node_debug(cfg: ShellConfig, namespace: str,
+                                    node_name: str) -> dict:
+    """manifests.go:27-73: host-network pod pinned to the node,
+    tolerating every taint; optional read-only(/rw) host mount."""
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": _rand_name(), "namespace": namespace},
+        "spec": {
+            "nodeName": node_name,
+            "restartPolicy": "Never",
+            "tolerations": [{"operator": "Exists"}],
+            "hostNetwork": True,
+            "hostPID": cfg.host_pid,
+            "containers": [{
+                "name": "retina-shell",
+                "image": cfg.image,
+                "stdin": True,
+                "tty": True,
+                "securityContext": {
+                    "capabilities": {
+                        "drop": ["ALL"],
+                        "add": list(cfg.capabilities),
+                    },
+                },
+            }],
+        },
+    }
+    if cfg.mount_host_filesystem or cfg.allow_host_filesystem_write:
+        pod["spec"]["volumes"] = [{
+            "name": "host-filesystem",
+            "hostPath": {"path": "/"},
+        }]
+        pod["spec"]["containers"][0]["volumeMounts"] = [{
+            "name": "host-filesystem",
+            "mountPath": "/host",
+            "readOnly": not cfg.allow_host_filesystem_write,
+        }]
+    return pod
+
+
+# -- validation (validation.go) ----------------------------------------
+def validate_node_os(client: KubeClient, node_name: str) -> None:
+    with client.request(client.url(CORE_V1, "nodes",
+                                   suffix=f"/{node_name}")) as r:
+        node = json.load(r)
+    os_label = (node.get("metadata", {}).get("labels") or {}).get(
+        "kubernetes.io/os", "")
+    if os_label != "linux":
+        raise RuntimeError(
+            f"unsupported OS on node {node_name} (retina-shell requires "
+            f"Linux, got {os_label!r})"
+        )
+
+
+# -- wait + attach (attach.go) -----------------------------------------
+# Waiting reasons that will never resolve on their own — fail fast
+# instead of burning the whole timeout.
+_FATAL_WAIT_REASONS = {
+    "ErrImagePull", "ImagePullBackOff", "InvalidImageName",
+    "CreateContainerError", "CreateContainerConfigError",
+    "RunContainerError",
+}
+
+
+def wait_for_container_running(client: KubeClient, namespace: str,
+                               pod_name: str, container: str,
+                               timeout_s: float) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with client.request(client.url(CORE_V1, "pods",
+                                           namespace=namespace,
+                                           suffix=f"/{pod_name}")) as r:
+                pod = json.load(r)
+        except Exception:  # noqa: BLE001 — transient apiserver blip:
+            time.sleep(1.0)  # keep polling until the deadline
+            continue
+        statuses = (
+            (pod.get("status", {}).get("containerStatuses") or [])
+            + (pod.get("status", {}).get("ephemeralContainerStatuses") or [])
+        )
+        for st in statuses:
+            if st.get("name") != container:
+                continue
+            state = st.get("state") or {}
+            if "running" in state:
+                return
+            waiting = state.get("waiting") or {}
+            if waiting.get("reason") in _FATAL_WAIT_REASONS:
+                raise RuntimeError(
+                    f"container {container} cannot start: "
+                    f"{waiting.get('reason')} "
+                    f"({waiting.get('message', '')[:200]})"
+                )
+            term = state.get("terminated") or {}
+            if term:
+                raise RuntimeError(
+                    f"container {container} terminated "
+                    f"(exit {term.get('exitCode')}, "
+                    f"{term.get('reason', '')})"
+                )
+        time.sleep(1.0)
+    raise TimeoutError(
+        f"container {container} in {namespace}/{pod_name} not running "
+        f"after {timeout_s:.0f}s"
+    )
+
+
+def kubectl_attach(namespace: str, pod_name: str, container: str,
+                   kubeconfig: str) -> Optional[int]:
+    """TTY attach via kubectl (the SPDY client the reference embeds).
+
+    Returns None when kubectl is absent — "never attached": the caller
+    must then LEAVE the debug pod in place so the printed manual attach
+    command actually has a target.
+    """
+    kubectl = shutil.which("kubectl")
+    if kubectl is None:
+        print(
+            f"kubectl not found — attach manually with:\n"
+            f"  kubectl --kubeconfig {kubeconfig} -n {namespace} attach "
+            f"-it {pod_name} -c {container}",
+            file=sys.stderr,
+        )
+        return None
+    return subprocess.call([
+        kubectl, "--kubeconfig", kubeconfig, "-n", namespace,
+        "attach", "-it", pod_name, "-c", container,
+    ])
+
+
+AttachFn = Callable[[str, str, str, str], Optional[int]]
+
+
+# -- entry points (shell.go) -------------------------------------------
+def run_in_pod(cfg: ShellConfig, kubeconfig: str, namespace: str,
+               pod_name: str,
+               attach: Optional[AttachFn] = None) -> int:
+    """shell.go:28-65 RunInPod: ephemeral container + attach."""
+    client = KubeClient(kubeconfig)
+    with client.request(client.url(CORE_V1, "pods", namespace=namespace,
+                                   suffix=f"/{pod_name}")) as r:
+        pod = json.load(r)
+    node_name = pod.get("spec", {}).get("nodeName", "")
+    if not node_name:
+        raise RuntimeError(
+            f"pod {namespace}/{pod_name} is not scheduled to a node yet"
+        )
+    validate_node_os(client, node_name)
+
+    ec = ephemeral_container_for_pod_debug(cfg)
+    print(f"Starting ephemeral container in pod {namespace}/{pod_name}")
+    body = json.dumps({
+        "spec": {"ephemeralContainers": [ec]},
+    }).encode()
+    client.request(
+        client.url(CORE_V1, "pods", namespace=namespace,
+                   suffix=f"/{pod_name}/ephemeralcontainers"),
+        method="PATCH", body=body,
+        content_type="application/strategic-merge-patch+json",
+    ).close()
+    wait_for_container_running(client, namespace, pod_name, ec["name"],
+                               cfg.timeout_s)
+    rc = (attach or kubectl_attach)(namespace, pod_name, ec["name"],
+                                    kubeconfig)
+    # None = never attached (no kubectl); the ephemeral container stays
+    # either way — k8s has no removal API for them.
+    return 1 if rc is None else rc
+
+
+def run_in_node(cfg: ShellConfig, kubeconfig: str, node_name: str,
+                namespace: str = "kube-system",
+                attach: Optional[AttachFn] = None) -> int:
+    """shell.go:67-105 RunInNode: debug pod + attach + cleanup."""
+    client = KubeClient(kubeconfig)
+    validate_node_os(client, node_name)
+    pod = host_network_pod_for_node_debug(cfg, namespace, node_name)
+    name = pod["metadata"]["name"]
+    print(f"Starting host networking pod {namespace}/{name} "
+          f"on node {node_name}")
+    client.request(
+        client.url(CORE_V1, "pods", namespace=namespace),
+        method="POST", body=json.dumps(pod).encode(),
+    ).close()
+    rc: Optional[int] = 1
+    try:
+        wait_for_container_running(client, namespace, name,
+                                   "retina-shell", cfg.timeout_s)
+        rc = (attach or kubectl_attach)(namespace, name,
+                                        "retina-shell", kubeconfig)
+        return 1 if rc is None else rc
+    finally:
+        if rc is None:
+            # Never attached (no kubectl): keep the pod so the printed
+            # manual attach command has a target.
+            print(f"debug pod {namespace}/{name} left running; delete "
+                  f"it when done: kubectl --kubeconfig {kubeconfig} "
+                  f"-n {namespace} delete pod {name}", file=sys.stderr)
+        else:
+            # Best-effort cleanup (shell.go:91-99).
+            try:
+                client.request(
+                    client.url(CORE_V1, "pods", namespace=namespace,
+                               suffix=f"/{name}"),
+                    method="DELETE",
+                ).close()
+            except Exception as e:  # noqa: BLE001
+                print(f"failed to delete pod {name}: {e}",
+                      file=sys.stderr)
+
+
+# -- local diagnostic shell --------------------------------------------
+def tool_inventory(which: Callable[[str], Optional[str]] = shutil.which
+                   ) -> dict[str, bool]:
+    return {t: which(t) is not None for t in SHELL_TOOLS}
+
+
+def agent_status(api_addr: str, fetch=None) -> dict:
+    """One-line agent health for the banner; never raises."""
+    fetch = fetch or (lambda url: urllib.request.urlopen(url, timeout=2))
+    out: dict = {"reachable": False}
+    try:
+        with fetch(f"http://{api_addr}/debug/vars") as r:
+            doc = json.load(r)
+        out["reachable"] = True
+        out["pods"] = doc.get("pods")
+        out["filter_ips"] = doc.get("filter_ips")
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def local_shell_env(api_addr: str, hubble_addr: str) -> dict[str, str]:
+    """Environment the debug session gets (agent endpoints at hand)."""
+    return {
+        "RETINA_API": f"http://{api_addr}",
+        "RETINA_METRICS_URL": f"http://{api_addr}/metrics",
+        "RETINA_HUBBLE_ADDR": hubble_addr,
+        "PS1": r"retina-shell \w $ ",
+    }
+
+
+def run_local(api_addr: str = "127.0.0.1:10093",
+              hubble_addr: str = "127.0.0.1:4244",
+              execvpe=os.execvpe) -> int:
+    """Single-host debug shell: banner + env + exec($SHELL)."""
+    tools = tool_inventory()
+    missing = sorted(t for t, ok in tools.items() if not ok)
+    status = agent_status(api_addr)
+    print("retina-tpu debug shell")
+    if status.get("reachable"):
+        print(f"  agent: up at {api_addr} "
+              f"(pods={status.get('pods')}, "
+              f"filter_ips={status.get('filter_ips')})")
+    else:
+        print(f"  agent: NOT reachable at {api_addr}")
+    if missing:
+        print(f"  missing tools: {', '.join(missing)}")
+    print("  env: RETINA_API, RETINA_METRICS_URL, RETINA_HUBBLE_ADDR")
+    env = {**os.environ, **local_shell_env(api_addr, hubble_addr)}
+    shell = os.environ.get("SHELL", "/bin/sh")
+    execvpe(shell, [shell], env)
+    return 0  # pragma: no cover — execvpe does not return

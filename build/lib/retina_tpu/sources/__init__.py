@@ -1,0 +1,19 @@
+"""Host-side event sources — the kernel-hook analog layer (L1).
+
+TPUs have no kernel hooks, so the reference's eBPF programs
+(pkg/plugin/*/_cprog/*.c) map to host-side sources that produce the same
+fixed-width event records (SURVEY.md §7 design mapping):
+
+- :mod:`retina_tpu.sources.pcapdecode` — packet-bytes → records decoder
+  (the packetparser.c parse path), vectorized with numpy, with an optional
+  C++ fast path (retina_tpu.native).
+- :mod:`retina_tpu.events.synthetic` — trafficgen analog.
+- :mod:`retina_tpu.sources.live` — AF_PACKET live capture (root-gated).
+"""
+
+from retina_tpu.sources.pcapdecode import (
+    PcapDecodeResult,
+    decode_pcap_bytes,
+    decode_pcap_file,
+    synthesize_pcap,
+)

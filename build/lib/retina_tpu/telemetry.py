@@ -1,0 +1,122 @@
+"""Self-telemetry: heartbeat with cardinality + process stats.
+
+Reference analog: pkg/telemetry/telemetry.go — an AppInsights client that
+tracks events/metrics/panics and a heartbeat that self-reports the agent's
+own metric cardinality (:170-258) and perf counters (:335-353), with a
+noop fallback (noop_telemetry.go) when telemetry is disabled.
+
+No external sink exists here (zero egress), so the "client" writes
+structured heartbeat records to the log and exposes the latest heartbeat
+via ``last_heartbeat`` (surfaced on /debug/vars). The perf-span helper
+mirrors TrackPerformanceCounter wrapping plugin reconciles
+(pluginmanager.go:93).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+import psutil
+
+from retina_tpu.exporter import Exporter, get_exporter
+from retina_tpu.log import logger
+
+_log = logger("telemetry")
+
+
+class Telemetry:
+    """Heartbeat + perf spans (reference TelemetryClient)."""
+
+    def __init__(
+        self,
+        interval_s: float = 900.0,
+        exporter: Optional[Exporter] = None,
+        properties: Optional[dict[str, str]] = None,
+    ) -> None:
+        self._interval = interval_s
+        self._exporter = exporter or get_exporter()
+        self._props = dict(properties or {})
+        self._proc = psutil.Process()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last_heartbeat: dict[str, Any] = {}
+
+    # -- cardinality self-report (telemetry.go:196-258) --
+    def metrics_cardinality(self) -> int:
+        text = self._exporter.gather_text()
+        return sum(
+            1
+            for line in text.splitlines()
+            if line and not line.startswith(b"#")
+        )
+
+    def heartbeat(self) -> dict[str, Any]:
+        with self._proc.oneshot():
+            hb: dict[str, Any] = {
+                "ts": time.time(),
+                "metrics_cardinality": self.metrics_cardinality(),
+                "cpu_percent": self._proc.cpu_percent(interval=None),
+                "rss_bytes": self._proc.memory_info().rss,
+                "num_threads": self._proc.num_threads(),
+                **self._props,
+            }
+        self.last_heartbeat = hb
+        _log.info(
+            "heartbeat cardinality=%d rss_mb=%.1f threads=%d",
+            hb["metrics_cardinality"],
+            hb["rss_bytes"] / 1e6,
+            hb["num_threads"],
+        )
+        return hb
+
+    @contextlib.contextmanager
+    def perf_span(self, name: str) -> Iterator[None]:
+        """Track a function span (TrackPerformanceCounter analog)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            _log.debug("span %s took %.3fs", name, time.perf_counter() - t0)
+
+    def track_panic(self, where: str, exc: BaseException) -> None:
+        _log.error("panic in %s: %r", where, exc)
+
+    def start_heartbeat(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop() -> None:
+            while not self._stop.wait(self._interval):
+                try:
+                    self.heartbeat()
+                except Exception:
+                    _log.exception("heartbeat failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="telemetry-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class NoopTelemetry(Telemetry):
+    """Disabled telemetry (reference noop_telemetry.go)."""
+
+    def __init__(self) -> None:
+        super().__init__(interval_s=1e9)
+
+    def heartbeat(self) -> dict[str, Any]:
+        return {}
+
+    def start_heartbeat(self) -> None:
+        pass
+
+
+def new_telemetry(enabled: bool, interval_s: float = 900.0,
+                  **kw: Any) -> Telemetry:
+    return Telemetry(interval_s=interval_s, **kw) if enabled else NoopTelemetry()

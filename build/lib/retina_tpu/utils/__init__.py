@@ -1,0 +1,4 @@
+"""Small shared helpers (reference pkg/utils, internal/ktime, buildinfo)."""
+
+from retina_tpu.utils.metric_names import *  # noqa: F401,F403
+from retina_tpu.utils.ktime import boot_offset_ns, monotonic_to_wall_ns

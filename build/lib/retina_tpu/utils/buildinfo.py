@@ -1,0 +1,5 @@
+"""Build metadata (reference internal/buildinfo: ldflags-injected vars)."""
+
+VERSION = "0.1.0"
+APP_NAME = "retina-tpu"
+USER_AGENT = f"{APP_NAME}/{VERSION}"

@@ -1,0 +1,110 @@
+"""Single-threaded device call proxy.
+
+The agent is aggressively multi-threaded on the host side (plugin feeds,
+the engine dispatch loop, scrape handlers, watcher reconciles, the
+metrics-module publisher), but the accelerator runtime under it is not
+guaranteed thread-safe — on the axon-tunnel TPU backend, concurrent
+device_put / device_get / jit dispatches from different threads were
+observed to wedge the client permanently (dispatch stuck in device_put,
+two scrapers stuck in device_get, a C++ exception at teardown). PCIe
+backends tolerate concurrency but gain nothing from it: every bulk
+transfer and step dispatch bottoms out in one serialized runtime anyway.
+
+So ALL engine-side JAX interaction routes through this proxy: one daemon
+thread owns the calls, callers enqueue closures and block on the result.
+Per-call overhead is a queue round-trip (~tens of µs) against device
+operations that are ms-scale; correctness is a structural guarantee
+instead of a lock discipline.
+
+Re-entrant calls (a proxied closure calling run_on_device) execute
+directly on the proxy thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, TypeVar
+
+T = TypeVar("T")
+
+_lock = threading.Lock()
+_q: queue.Queue | None = None
+_thread: threading.Thread | None = None
+
+
+def _loop(q: queue.Queue) -> None:
+    while True:
+        fn, args, kwargs, box, done = q.get()
+        try:
+            box.append(fn(*args, **kwargs))
+        except BaseException as e:  # noqa: BLE001 — delivered to caller
+            box.append(e)
+            box.append(True)
+        finally:
+            done.set()
+
+
+def _ensure_thread() -> queue.Queue:
+    global _q, _thread
+    with _lock:
+        if _q is None:
+            _q = queue.Queue()
+            _thread = threading.Thread(
+                target=_loop, args=(_q,), name="device-proxy", daemon=True
+            )
+            _thread.start()
+        return _q
+
+
+def run_on_device(fn: Callable[..., T], *args: Any, **kwargs: Any) -> T:
+    """Execute ``fn(*args, **kwargs)`` on the device proxy thread and
+    return (or re-raise) its result."""
+    if threading.current_thread() is _thread:
+        return fn(*args, **kwargs)
+    q = _ensure_thread()
+    box: list = []
+    done = threading.Event()
+    q.put((fn, args, kwargs, box, done))
+    done.wait()
+    if len(box) == 2:
+        raise box[0]
+    return box[0]
+
+
+def submit_on_device(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
+    """Fire-and-forget: enqueue ``fn`` on the proxy thread and return
+    immediately.
+
+    The proxy queue is FIFO, so submissions execute in submission order,
+    interleaved with (and ordered against) ``run_on_device`` calls — a
+    later blocking call acts as a fence for everything submitted before
+    it. Exceptions are swallowed (nobody awaits the result): ``fn`` MUST
+    handle its own failures. Callers are responsible for bounding the
+    number of outstanding submissions (the engine uses a semaphore
+    released from inside the closure) or host memory pins the payloads
+    of an unbounded backlog.
+    """
+    if threading.current_thread() is _thread:
+        try:
+            fn(*args, **kwargs)
+        except BaseException:  # noqa: BLE001 — contract: fn self-handles
+            pass
+        return
+    q = _ensure_thread()
+    q.put((fn, args, kwargs, [], threading.Event()))
+
+
+def fence(timeout: float | None = None) -> bool:
+    """Block until everything submitted before this call has executed.
+
+    Returns False if ``timeout`` (seconds) elapsed first — a wedged
+    proxy thread (the failure mode this module contains) must not turn
+    a bounded shutdown into an unbounded hang.
+    """
+    if threading.current_thread() is _thread:
+        return True
+    q = _ensure_thread()
+    done = threading.Event()
+    q.put((lambda: None, (), {}, [], done))
+    return done.wait(timeout)

@@ -1,0 +1,275 @@
+"""helmlite: render Helm charts without a helm binary.
+
+Reference analog: the reference ships a values-driven Helm chart
+(`deploy/standard/manifests/controller/helm/retina/templates/*`) and
+drives installs through the helm SDK (`deploy/standard/*.go`). This
+framework's chart (deploy/helm/retina-tpu) is a REAL chart — installable
+with stock `helm install` — but the repo also needs to render it without
+helm: the CLI's ``deploy render`` verb (air-gapped clusters, kubectl
+apply pipelines) and the manifest-coherence tests both run in
+environments where only Python exists.
+
+So this module implements the Go-template subset the chart restricts
+itself to:
+
+- actions with whitespace control: ``{{ expr }}``, ``{{- expr -}}``
+- data paths: ``.Values.a.b``, ``.Release.Name/Namespace``,
+  ``.Chart.Name/Version``
+- literals: double-quoted strings, ints, true/false
+- pipelines: ``expr | fn arg ...`` with quote, toYaml, indent N,
+  nindent N, default X
+- control flow: ``if`` / ``else`` / ``end`` (Go truthiness: empty
+  string/list/map, 0, false, nil are falsy)
+- comments: ``{{/* ... */}}``
+
+Anything outside the subset raises — a template drifting beyond it
+should fail tests loudly, not render wrongly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any
+
+import yaml
+
+_ACTION = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.DOTALL)
+
+
+class HelmliteError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Expression evaluation
+
+
+def _truthy(v: Any) -> bool:
+    return not (v is None or v is False or v == "" or v == [] or v == {} or v == 0)
+
+
+def _to_yaml(v: Any) -> str:
+    return yaml.safe_dump(v, default_flow_style=False, sort_keys=False).rstrip("\n")
+
+
+def _indent(n: int, s: str) -> str:
+    pad = " " * n
+    return "\n".join(pad + line if line else line for line in str(s).split("\n"))
+
+
+def _fmt(v: Any) -> str:
+    """Go template default formatting for interpolated values."""
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return str(v)
+
+
+_TOKEN = re.compile(r'"(?:[^"\\]|\\.)*"|\S+')
+
+
+def _eval_atom(tok: str, ctx: dict[str, Any]) -> Any:
+    if tok.startswith('"'):
+        return json.loads(tok)
+    if tok in ("true", "false"):
+        return tok == "true"
+    if re.fullmatch(r"-?\d+", tok):
+        return int(tok)
+    if re.fullmatch(r"-?\d+\.\d+", tok):
+        return float(tok)
+    if tok.startswith("."):
+        cur: Any = ctx
+        for part in tok[1:].split("."):
+            if not part:
+                raise HelmliteError(f"bad path {tok!r}")
+            if isinstance(cur, dict) and part in cur:
+                cur = cur[part]
+            else:
+                return None
+        return cur
+    raise HelmliteError(f"unsupported token {tok!r}")
+
+
+def _apply_fn(name: str, args: list[Any]) -> Any:
+    if name == "quote":
+        (v,) = args
+        return json.dumps("" if v is None else str(_fmt(v)))
+    if name == "toYaml":
+        (v,) = args
+        return _to_yaml(v)
+    if name == "indent":
+        n, v = args
+        return _indent(int(n), v)
+    if name == "nindent":
+        n, v = args
+        return "\n" + _indent(int(n), v)
+    if name == "default":
+        dflt, v = args
+        return v if _truthy(v) else dflt
+    raise HelmliteError(f"unsupported function {name!r}")
+
+
+_FUNCTIONS = ("quote", "toYaml", "indent", "nindent", "default")
+
+
+def eval_expr(expr: str, ctx: dict[str, Any]) -> Any:
+    """Evaluate one pipeline expression against the context."""
+    stages = [s.strip() for s in expr.split("|")]
+    value: Any = None
+    for i, stage in enumerate(stages):
+        toks = _TOKEN.findall(stage)
+        if not toks:
+            raise HelmliteError(f"empty pipeline stage in {expr!r}")
+        if toks[0] in _FUNCTIONS:
+            args = [_eval_atom(t, ctx) for t in toks[1:]]
+            if i > 0:
+                args.append(value)
+            value = _apply_fn(toks[0], args)
+        else:
+            if len(toks) != 1 or i > 0:
+                raise HelmliteError(f"unsupported expression {stage!r}")
+            value = _eval_atom(toks[0], ctx)
+    return value
+
+
+# ---------------------------------------------------------------------------
+# Template parsing/rendering
+
+
+def render(template: str, ctx: dict[str, Any]) -> str:
+    """Render one template body with Go-template whitespace semantics."""
+    # Tokenize into (literal, action) runs with trim flags applied.
+    pos = 0
+    parts: list[tuple[str, str]] = []  # ("lit", text) | ("act", body)
+    for mobj in _ACTION.finditer(template):
+        lit = template[pos : mobj.start()]
+        if mobj.group(1) == "-":
+            lit = re.sub(r"[ \t]*\n?[ \t]*$", "", lit)
+        parts.append(("lit", lit))
+        parts.append(("act", mobj.group(2)))
+        pos = mobj.end()
+        if mobj.group(3) == "-":
+            rest = template[pos:]
+            trimmed = re.sub(r"^[ \t]*\n?", "", rest, count=1)
+            pos += len(rest) - len(trimmed)
+    parts.append(("lit", template[pos:]))
+
+    out: list[str] = []
+    # Stack of (emitting_before, branch_taken, in_else)
+    stack: list[tuple[bool, bool, bool]] = []
+    emitting = True
+    for kind, text in parts:
+        if kind == "lit":
+            if emitting:
+                out.append(text)
+            continue
+        body = text.strip()
+        if body.startswith("/*"):
+            continue
+        if body.startswith("if "):
+            cond = emitting and _truthy(eval_expr(body[3:], ctx))
+            stack.append((emitting, cond, False))
+            emitting = emitting and cond
+        elif body == "else":
+            if not stack:
+                raise HelmliteError("else without if")
+            outer, taken, in_else = stack[-1]
+            if in_else:
+                raise HelmliteError("double else")
+            stack[-1] = (outer, taken, True)
+            emitting = outer and not taken
+        elif body == "end":
+            if not stack:
+                raise HelmliteError("end without if")
+            emitting = stack.pop()[0]
+        else:
+            if emitting:
+                out.append(_fmt(eval_expr(body, ctx)))
+    if stack:
+        raise HelmliteError("unclosed if")
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Chart-level API
+
+
+def _deep_merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _set_path(values: dict, dotted: str, raw: str) -> None:
+    cur = values
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[parts[-1]] = yaml.safe_load(raw)
+
+
+def render_chart(
+    chart_dir: str,
+    release_name: str = "retina-tpu",
+    namespace: str | None = None,
+    values_files: list[str] | None = None,
+    set_values: list[str] | None = None,
+) -> dict[str, str]:
+    """Render every template of a chart. Returns {template_name: yaml}."""
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        chart_meta = yaml.safe_load(f)
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        values = yaml.safe_load(f) or {}
+    for vf in values_files or []:
+        with open(vf) as f:
+            values = _deep_merge(values, yaml.safe_load(f) or {})
+    for sv in set_values or []:
+        key, _, raw = sv.partition("=")
+        _set_path(values, key, raw)
+    ctx = {
+        "Values": values,
+        # Match real helm exactly: the release namespace comes from the
+        # -n/--namespace flag (default "default"), never from values —
+        # helm itself ignores a values.yaml `namespace:` key, so reading
+        # it here would silently diverge from `helm template`.
+        "Release": {
+            "Name": release_name,
+            "Namespace": namespace or "default",
+        },
+        "Chart": {
+            "Name": chart_meta.get("name", ""),
+            "Version": str(chart_meta.get("version", "")),
+        },
+    }
+    tdir = os.path.join(chart_dir, "templates")
+    out: dict[str, str] = {}
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(tdir, name)) as f:
+            body = render(f.read(), ctx)
+        if body.strip():
+            out[name] = body
+    return out
+
+
+def render_chart_docs(chart_dir: str, **kw: Any) -> list[dict]:
+    """Render and YAML-parse a chart into its manifest documents."""
+    docs: list[dict] = []
+    for name, body in render_chart(chart_dir, **kw).items():
+        try:
+            for doc in yaml.safe_load_all(body):
+                if doc:
+                    docs.append(doc)
+        except yaml.YAMLError as e:
+            raise HelmliteError(f"{name}: invalid YAML after render: {e}") from e
+    return docs

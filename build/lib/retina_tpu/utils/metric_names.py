@@ -1,0 +1,107 @@
+"""Prometheus metric name constants.
+
+Reference analog: pkg/utils/metric_names.go:14-36 — every exported series
+carries the ``networkobservability_`` prefix; basic (node-level) names and
+advanced (pod-level, ``adv_``) names are distinct families.
+"""
+
+PREFIX = "networkobservability_"
+
+# Basic node-level metrics (default registry).
+DROP_COUNT = PREFIX + "drop_count"
+DROP_BYTES = PREFIX + "drop_bytes"
+FORWARD_COUNT = PREFIX + "forward_count"
+FORWARD_BYTES = PREFIX + "forward_bytes"
+TCP_STATE = PREFIX + "tcp_state"
+TCP_CONNECTION_REMOTE = PREFIX + "tcp_connection_remote"
+TCP_CONNECTION_STATS = PREFIX + "tcp_connection_stats"
+TCP_FLAG_COUNTERS = PREFIX + "tcp_flag_counters"
+IP_CONNECTION_STATS = PREFIX + "ip_connection_stats"
+UDP_CONNECTION_STATS = PREFIX + "udp_connection_stats"
+INTERFACE_STATS = PREFIX + "interface_stats"
+INFINIBAND_COUNTER_STATS = PREFIX + "infiniband_counter_stats"
+INFINIBAND_STATUS_PARAMS = PREFIX + "infiniband_status_params"
+DNS_REQUEST_COUNT = PREFIX + "dns_request_count"
+DNS_RESPONSE_COUNT = PREFIX + "dns_response_count"
+NODE_CONNECTIVITY_STATUS = PREFIX + "node_connectivity_status"
+NODE_CONNECTIVITY_LATENCY = PREFIX + "node_connectivity_latency_seconds"
+CONNTRACK_PACKETS = PREFIX + "conntrack_packets"
+CONNTRACK_BYTES = PREFIX + "conntrack_bytes"
+
+# Advanced pod-level metrics (resettable advanced registry).
+ADV_PREFIX = PREFIX + "adv_"
+ADV_FORWARD_COUNT = ADV_PREFIX + "forward_count"
+ADV_FORWARD_BYTES = ADV_PREFIX + "forward_bytes"
+ADV_DROP_COUNT = ADV_PREFIX + "drop_count"
+ADV_DROP_BYTES = ADV_PREFIX + "drop_bytes"
+ADV_TCP_FLAG_COUNTERS = ADV_PREFIX + "tcpflags_count"
+ADV_TCP_RETRANS_COUNT = ADV_PREFIX + "tcpretrans_count"
+ADV_DNS_REQUEST_COUNT = ADV_PREFIX + "dns_request_count"
+ADV_DNS_RESPONSE_COUNT = ADV_PREFIX + "dns_response_count"
+ADV_API_LATENCY = ADV_PREFIX + "node_apiserver_latency"
+ADV_API_NO_RESPONSE = ADV_PREFIX + "node_apiserver_no_response"
+
+# Sketch-derived series (new in the TPU framework).
+SKETCH_PREFIX = PREFIX + "sketch_"
+HEAVY_HITTER_FLOWS = SKETCH_PREFIX + "heavy_hitter_flow_packets"
+HEAVY_HITTER_SERVICES = SKETCH_PREFIX + "service_graph_packets"
+HEAVY_HITTER_DNS = SKETCH_PREFIX + "dns_heavy_hitter_count"
+DISTINCT_FLOWS = SKETCH_PREFIX + "distinct_flows"
+DISTINCT_SRC_PER_REASON = SKETCH_PREFIX + "distinct_sources_per_drop_reason"
+DISTINCT_SRC_PER_POD = SKETCH_PREFIX + "distinct_sources_per_pod"
+ENTROPY_BITS = SKETCH_PREFIX + "entropy_bits"
+ANOMALY_FLAG = SKETCH_PREFIX + "anomaly_flag"
+ANOMALY_ZSCORE = SKETCH_PREFIX + "anomaly_zscore"
+# Monotonic count of anomalous windows: the flag gauge only shows
+# the CURRENT window, which a 10-30s scrape cadence would miss for
+# sub-second windows.
+ANOMALY_WINDOWS = SKETCH_PREFIX + "anomaly_windows_total"
+ACTIVE_CONNECTIONS = PREFIX + "conntrack_active_connections"
+
+# Control-plane self metrics (reference pkg/metrics/metrics.go:14-120).
+PLUGIN_RECONCILE_FAILURES = PREFIX + "plugin_manager_failed_to_reconcile"
+LOST_EVENTS = PREFIX + "lost_events_counter"
+# Table entries (filter IPs / pod identities) dropped because a
+# fixed-capacity device table was full — the agent clamps and stays up
+# (reference counts per-IP map-write failures the same way,
+# manager_linux.go:62-100).
+LOST_TABLE_ENTRIES = PREFIX + "lost_table_entries_counter"
+# Filter-map device pushes that exhausted every retry (transient device
+# failure outlasting the backoff): the device filter set is stale until
+# the next successful push — invisible without this counter.
+FILTER_PUSH_FAILURES = PREFIX + "filter_push_failures_counter"
+# v2-wire flow dictionary self-observability: resident descriptors,
+# generation (bumps = capacity cycles or failure resyncs), and wire
+# rows by kind — known/new ratio IS the wire savings factor.
+FLOW_DICT_ENTRIES = PREFIX + "tpu_flow_dict_entries"
+FLOW_DICT_GENERATION = PREFIX + "tpu_flow_dict_generation"
+WIRE_ROWS = PREFIX + "tpu_wire_rows_counter"
+L_KIND = "kind"
+PARSED_PACKETS = PREFIX + "parsed_packets_counter"
+DEVICE_STEP_SECONDS = PREFIX + "tpu_step_seconds"
+DEVICE_BATCH_FILL = PREFIX + "tpu_batch_fill_ratio"
+WINDOWS_CLOSED = PREFIX + "tpu_windows_closed"
+COMBINE_RATIO = PREFIX + "host_combine_ratio"
+TRANSFER_SECONDS = PREFIX + "tpu_transfer_seconds"
+TRANSFER_BYTES = PREFIX + "tpu_transfer_bytes"
+
+# Label keys (reference pkg/utils/metric_names.go label constants).
+L_DIRECTION = "direction"
+L_REASON = "reason"
+L_FLAG = "flag"
+L_POD = "podname"
+L_NAMESPACE = "namespace"
+L_WORKLOAD = "workload_kind"
+L_IP = "ip"
+L_PORT = "port"
+L_PROTO = "protocol"
+L_QTYPE = "query_type"
+L_RCODE = "return_code"
+L_DIMENSION = "dimension"
+L_STAGE = "stage"
+L_TABLE = "table"
+L_PLUGIN = "plugin"
+L_STATE = "state"
+L_INTERFACE = "interface_name"
+L_STAT = "statistic_name"
+L_BUCKET = "le_ms"

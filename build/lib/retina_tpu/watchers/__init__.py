@@ -1,0 +1,1 @@
+"""Host watchers (reference pkg/watchers): endpoint + apiserver."""
