@@ -795,11 +795,12 @@ class SketchEngine:
         known_wire = np.zeros((D, Bk, 2), np.uint32)
         nv_new = np.zeros((D,), np.uint32)
         nv_known = np.zeros((D,), np.uint32)
+        from retina_tpu.native import flowwire_native
+
         for d, (rows, ids, _) in enumerate(per_dev):
             sel = sel_new[d]
-            rn, idn = rows[sel], ids[sel]
-            rk, idk = rows[~sel], ids[~sel]
-            if len(rn) > Bn or len(rk) > Bk:
+            nn, nk = n_new[d], n_known[d]
+            if nn > Bn or nk > Bk:
                 # Unreachable from in-tree callers (partition capacity
                 # == the _wire_bucket cap). Dropping new rows here
                 # would be CORRUPTION, not loss: their descriptors are
@@ -807,20 +808,36 @@ class SketchEngine:
                 # reference never-written table slots. Fail loudly; the
                 # caller's resync handler rebuilds both sides.
                 raise RuntimeError(
-                    f"flow-dict wire overflow: {len(rn)}/{Bn} new, "
-                    f"{len(rk)}/{Bk} known rows on device {d}"
+                    f"flow-dict wire overflow: {nn}/{Bn} new, "
+                    f"{nk}/{Bk} known rows on device {d}"
                 )
-            if len(rn):
-                packed12, _, _ = pack_records(rn, base=base)
-                new_wire[d, : len(rn), 0] = idn
-                new_wire[d, : len(rn), 1:] = packed12
-            if len(rk):
-                known_wire[d, : len(rk), 0] = (
-                    idk | (rk[:, F.PACKETS] << id_bits)
+            got = None
+            if len(rows):
+                # One native pass builds both sides in place — the
+                # numpy path below pays two fancy-indexed row copies +
+                # a pack pass + two bit-pack passes per device.
+                got = flowwire_native(
+                    np.ascontiguousarray(rows), ids,
+                    sel.astype(np.uint8), int(base),
+                    int(self._fd_id_bits),
+                    new_wire[d], known_wire[d],
                 )
-                known_wire[d, : len(rk), 1] = rk[:, F.BYTES]
-            nv_new[d] = len(rn)
-            nv_known[d] = len(rk)
+            if got is not None:
+                assert got == nn, (got, nn)
+            elif len(rows):
+                rn, idn = rows[sel], ids[sel]
+                rk, idk = rows[~sel], ids[~sel]
+                if len(rn):
+                    packed12, _, _ = pack_records(rn, base=base)
+                    new_wire[d, : len(rn), 0] = idn
+                    new_wire[d, : len(rn), 1:] = packed12
+                if len(rk):
+                    known_wire[d, : len(rk), 0] = (
+                        idk | (rk[:, F.PACKETS] << id_bits)
+                    )
+                    known_wire[d, : len(rk), 1] = rk[:, F.BYTES]
+            nv_new[d] = nn
+            nv_known[d] = nk
         if record_metrics and lost:
             m.lost_events.labels(
                 stage="partition", plugin="engine"

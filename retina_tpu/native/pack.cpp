@@ -28,6 +28,29 @@ constexpr int F_TS_LO = 0, F_TS_HI = 1, F_SRC_IP = 2, F_DST_IP = 3,
 
 inline uint32_t min_u32(uint32_t a, uint32_t b) { return a < b ? a : b; }
 
+// One packed wire row (the body shared by rt_pack and rt_flowwire —
+// must stay semantically identical to pack_records' numpy math).
+inline void pack_row(const uint32_t* r, uint32_t* o, uint64_t base) {
+  constexpr uint64_t U32 = 0xFFFFFFFFull;
+  uint64_t ts = ((uint64_t)r[F_TS_HI] << 32) | r[F_TS_LO];
+  uint64_t diff = ts - base;  // wraps when ts < base, like numpy u64
+  o[0] = ts > 0 ? (uint32_t)((diff < U32 - 1 ? diff : U32 - 1) + 1) : 0;
+  o[1] = r[F_SRC_IP];
+  o[2] = r[F_DST_IP];
+  o[3] = r[F_PORTS];
+  o[4] = r[F_META];
+  o[5] = r[F_BYTES];
+  o[6] = r[F_PACKETS];
+  o[7] = (min_u32(r[F_VERDICT], 7) << 29)
+       | (min_u32(r[F_DROP_REASON], 255) << 21)
+       | (min_u32(r[F_EVENT_TYPE], 15) << 17)
+       | min_u32(r[F_IFINDEX], 0x1FFFF);
+  o[8] = r[F_TSVAL];
+  o[9] = r[F_TSECR];
+  o[10] = r[F_DNS];
+  o[11] = r[F_DNS_QHASH];
+}
+
 }  // namespace
 
 extern "C" {
@@ -51,29 +74,40 @@ uint64_t rt_ts_base(const uint32_t* rows, size_t n) {
 // min() clamp saturates the relative timestamp).
 void rt_pack(const uint32_t* rows, size_t n, uint64_t base,
              uint32_t* out) {
-  constexpr uint64_t U32 = 0xFFFFFFFFull;
+  for (size_t i = 0; i < n; i++)
+    pack_row(rows + i * NUM_FIELDS, out + i * PACKED_FIELDS, base);
+}
+
+// v3 flow-dict wire build: ONE pass splits a device's rows into the
+// new-descriptor wire ([table_id | 12 packed lanes], 13 u32/row) and
+// the known wire ([id | packets << id_bits, bytes], 2 u32/row) by the
+// caller-computed escalation mask (engine._dispatch_flowdict computes
+// it in numpy: is_new | pk overflow | TSval/TSecr | unstamped). The
+// numpy equivalent needed two fancy-indexed row copies + a pack pass +
+// two bit-pack passes per flush — this is the dispatch worker's
+// largest remaining cost at production quanta.
+// new_out must hold at least (popcount(sel), 13); known_out at least
+// (n - popcount, 2). Returns n_new.
+long rt_flowwire(const uint32_t* rows, size_t n, const uint32_t* ids,
+                 const uint8_t* sel_new, uint64_t base,
+                 uint32_t id_bits, uint32_t* new_out,
+                 uint32_t* known_out) {
+  size_t n_new = 0, n_known = 0;
   for (size_t i = 0; i < n; i++) {
     const uint32_t* r = rows + i * NUM_FIELDS;
-    uint32_t* o = out + i * PACKED_FIELDS;
-    uint64_t ts = ((uint64_t)r[F_TS_HI] << 32) | r[F_TS_LO];
-    uint64_t diff = ts - base;  // wraps when ts < base, like numpy u64
-    o[0] = ts > 0 ? (uint32_t)((diff < U32 - 1 ? diff : U32 - 1) + 1)
-                  : 0;
-    o[1] = r[F_SRC_IP];
-    o[2] = r[F_DST_IP];
-    o[3] = r[F_PORTS];
-    o[4] = r[F_META];
-    o[5] = r[F_BYTES];
-    o[6] = r[F_PACKETS];
-    o[7] = (min_u32(r[F_VERDICT], 7) << 29)
-         | (min_u32(r[F_DROP_REASON], 255) << 21)
-         | (min_u32(r[F_EVENT_TYPE], 15) << 17)
-         | min_u32(r[F_IFINDEX], 0x1FFFF);
-    o[8] = r[F_TSVAL];
-    o[9] = r[F_TSECR];
-    o[10] = r[F_DNS];
-    o[11] = r[F_DNS_QHASH];
+    if (sel_new[i]) {
+      uint32_t* o = new_out + n_new * 13;
+      o[0] = ids[i];
+      pack_row(r, o + 1, base);
+      n_new++;
+    } else {
+      uint32_t* o = known_out + n_known * 2;
+      o[0] = ids[i] | (r[F_PACKETS] << id_bits);
+      o[1] = r[F_BYTES];
+      n_known++;
+    }
   }
+  return (long)n_new;
 }
 
 }  // extern "C"
