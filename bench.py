@@ -282,8 +282,9 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         probe_gen.batch(1 << 13) for _ in range(32 if smoke else 256)
     ]
     n_quantum = sum(len(b) for b in blocks)
-    fdict = make_flow_dict(1 << 18)
-    id_bits = np.uint32(18)
+    fd_bits = 18 if smoke else 21
+    fdict = make_flow_dict(1 << fd_bits)
+    id_bits = np.uint32(fd_bits)
     comb0 = combine_blocks(blocks)
     fdict.lookup_or_assign(
         partition_events(comb0, 1, 1 << 19, min_bucket=1 << 12)
@@ -323,6 +324,18 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         # bucket-grid warm into tens of minutes of tunnel compiles.)
         cfg.flush_max_events = 1 << 22
         cfg.feed_coalesce_windows = 8
+        # Size the flow dictionary to the workload's working set (1M
+        # distinct flows), exactly like the reference sizes its
+        # conntrack map to the expected connection count
+        # (conntrack.h:21-29: 262,144 LRU entries). Undersized, ~26% of
+        # combined rows re-registered as 52-byte new-descriptor rows
+        # every flush (the Zipf tail churning through the table) — 2.3x
+        # the wire bytes and twice the device-step work of the 8-byte
+        # known-row path. 2^21 slots hold the whole working set at load
+        # factor 0.5: table HBM is 2^21 x 12 lanes x 4B = 100 MB/device,
+        # and the id lane keeps 11 bits of packet headroom. Sizing
+        # guidance: docs/operations.md.
+        cfg.flow_dict_slots = 1 << 21
         # Full quanta before the age bound cuts them (0.4s default was
         # age-flushing at ~2.9M of the 4.2M quantum), and a deeper
         # in-flight window so multi-second tunnel stall episodes drain
@@ -391,8 +404,8 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     # serving throughout — this wait is about what the windows measure,
     # not about boot latency, which is reported above).
     t_warm = time.monotonic()
-    if not eng.bucket_warm_done.wait(300):
-        log("e2e: WARNING bucket grid warm not done after 300s; "
+    if not eng.bucket_warm_done.wait(600):
+        log("e2e: WARNING bucket grid warm not done after 600s; "
             "measuring anyway")
     else:
         log(f"e2e: bucket grid warm complete "
@@ -402,6 +415,7 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     def measure_window() -> dict:
         ev0 = eng._events_in
         bytes0 = m.transfer_bytes._value.get()
+        rb0 = m.readback_bytes._value.get()
         t0 = time.monotonic()
         lat: list[float] = []
         while time.monotonic() - t0 < dur:
@@ -411,9 +425,11 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         elapsed = time.monotonic() - t0
         ev1 = eng._events_in  # one snapshot: rate/events/bpe consistent
         bytes1 = m.transfer_bytes._value.get()
+        rb1 = m.readback_bytes._value.get()
         return {
             "rate": (ev1 - ev0) / elapsed,
             "wire_bytes": bytes1 - bytes0,
+            "readback_bytes": rb1 - rb0,
             "events": ev1 - ev0,
             "elapsed": elapsed,
             "lat": lat,
@@ -472,7 +488,14 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     combine_ratio = m.combine_ratio._value.get()
     # Sanity: the exposition must carry the data-plane families.
     assert "networkobservability_forward_count" in body
-    if wire_bpe * rate / 1e6 >= 0.5 * link_mbs:
+    # Link utilization counts BOTH directions: the tunnel serializes
+    # H2D wire transfers with D2H snapshot readbacks (scrape/GC/module
+    # cadence), so a window can be link-bound well below the H2D-only
+    # threshold.
+    link_used_mbs = (
+        (bytes_delta + win["readback_bytes"]) / win["elapsed"] / 1e6
+    )
+    if link_used_mbs >= 0.5 * link_mbs:
         bottleneck = "host->device link bandwidth"
     elif proxy_share >= 0.5:
         # The proxy thread spends most of its wall clock inside device
@@ -494,6 +517,8 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         "combine_ratio": round(combine_ratio, 2),
         "wire_bytes_per_event": round(wire_bpe, 2),
         "link_bandwidth_mbs": round(link_mbs, 1),
+        "link_used_mbs": round(link_used_mbs, 2),
+        "readback_bytes": int(win["readback_bytes"]),
         "bottleneck": bottleneck,
         "host_path_events_per_sec": round(host_path_rate),
         # What the measured wire efficiency implies on a production PCIe

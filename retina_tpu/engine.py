@@ -44,7 +44,7 @@ from retina_tpu.parallel.partition import (
 from retina_tpu.parallel.telemetry import ShardedTelemetry, topk_from_snapshot
 from retina_tpu.plugins.api import QueueSink
 from retina_tpu.utils.device_proxy import (
-    fence, run_on_device, submit_on_device,
+    fence, fetch_on_device, run_on_device, submit_on_device,
 )
 
 
@@ -193,9 +193,20 @@ class SketchEngine:
         self._snap_flight = threading.Lock()
         self._snap_cache: dict[str, Any] | None = None
         self._snap_time = 0.0
-        # Previous window's stacked device results awaiting harvest
-        # (proxy thread only).
-        self._pending_win: Any = None
+        # Closed windows' results awaiting publish on the harvest
+        # thread (lazily started at the first close). Unbounded BY
+        # DESIGN: items are (3,3)-float device handles produced at
+        # window cadence (one per window_seconds), so even an
+        # hours-long link stall accumulates only trivial host memory —
+        # and never shedding means every anomalous window's
+        # anomaly_windows increment survives to the next scrape (the
+        # counter's contract). Items: ("win", stacked_device_array),
+        # ("zero", None) for idle windows (FIFO through the same queue
+        # so an in-flight active window can never publish AFTER the
+        # idle zeroing and latch a stale anomaly flag), or None to
+        # shut the thread down.
+        self._harvest_q: queue_mod.Queue = queue_mod.Queue()
+        self._harvest_thread: threading.Thread | None = None
         self.last_window: dict[str, np.ndarray] = {}
         self._state_lock = threading.Lock()
         self.started = threading.Event()
@@ -320,6 +331,12 @@ class SketchEngine:
         the background AFTER ready (start_background_warm), one proxy
         call per key so live dispatches interleave."""
         t0 = time.perf_counter()
+
+        def mark(stage: str) -> None:
+            self.log.info(
+                "compile: %s at +%.1fs", stage, time.perf_counter() - t0
+            )
+
         # Full-capacity dispatch (the steady-state jit key: packed-wire
         # ingest at bucket == batch_capacity + the step with
         # device-resident scalars) through the REAL dispatch path.
@@ -333,33 +350,27 @@ class SketchEngine:
         )
         self._dispatch_sharded(full, now_s=1, n_raw=0,
                                record_metrics=False)
+        mark("full-capacity dispatch")
 
-        def warm():
-            self.state, win = self.sharded.end_window(
-                self.state, self._zthresh
-            )
-            self._win_readback(win)
-            # Warm BOTH snapshot programs: the device-dict one (tests,
-            # direct consumers) and the flat single-transfer one the
-            # scrape path uses (a cold compile here cost the first
-            # scrape ~40s on the tunnel).
-            snap = self.sharded.snapshot(self.state, 1)
-            jax.block_until_ready(snap["totals"])
-            self.sharded.snapshot_host(self.state, 1)
-
-        run_on_device(warm)
+        # Window-close + snapshot programs warm in the BACKGROUND
+        # (start_background_warm runs them before the bucket grid):
+        # they gate only the first scrape / first window tick — not the
+        # feed path — and their ~18s of warm-cache load time was most
+        # of the boot critical path (44.9s observed in BENCH r5 dry
+        # run). A scrape or window tick arriving inside the background
+        # warm window compiles inline, exactly as a cold key would.
         # Warm the smallest plain bucket (idle/interval flushes); the
         # rest of the bucket ladder is start_background_warm's job.
         self._dispatch(
             np.zeros((0, NUM_FIELDS), np.uint32), now_s=1,
             record_metrics=False,
         )
-        if self._flow_dict is not None:
-            # The idle/low-rate flush keys: a steady trickle produces
-            # min-bucket new+known pairs on every interval flush.
-            b0 = self._wire_bucket(0)
-            run_on_device(self._ingest_new_fn, b0)
-            run_on_device(self._ingest_known_fn, b0)
+        mark("min plain bucket")
+        # The min-bucket flow-dict pair (idle/interval-flush keys) is
+        # NOT warmed here: it is the first grid entry in
+        # start_background_warm (~12s of warm-cache load that would
+        # otherwise sit on the ready path); a trickle flush arriving
+        # before that warm lands compiles inline.
         self.log.info(
             "engine compiled: %d device(s), batch=%d, %.1fs",
             self.n_devices, self.cfg.batch_capacity,
@@ -405,30 +416,91 @@ class SketchEngine:
             n_warmed = 0
             n_failed = 0
             try:
-                for b in self._reachable_buckets():
+                # Warm order: min-bucket dispatch pair (a trickle feed
+                # needs it on its very first interval flush), then the
+                # window-close + snapshot programs (first scrape /
+                # window tick, in production 15-30s after boot), then
+                # the rest of the grid in ramp order. All moved off
+                # compile()'s critical path — together they were ~30s
+                # of the 45s boot observed in the r5 dry run.
+                #
+                # The end_window warm is a REAL close (with the close
+                # path's bookkeeping): its result rides the harvest
+                # queue like any window tick, so traffic (and any
+                # anomaly) ingested between ready and this warm
+                # publishes instead of vanishing — the only side effect
+                # is that the first entropy window is shorter than
+                # window_seconds.
+                def warm_close():
+                    ingested = self._events_in
+                    with self._state_lock:
+                        self.state, win = self.sharded.end_window(
+                            self.state, self._zthresh
+                        )
+                    stacked = self._win_stack(win)
+                    self._closed_events_in = ingested
+                    self._ensure_harvest_thread()
+                    self._harvest_q.put(("win", stacked))
+                    get_metrics().windows_closed.inc()
+
+                def warm_snap():
+                    snap = self.sharded.snapshot(self.state, 1)
+                    jax.block_until_ready(snap["totals"])
+
+                def warm_snap_flat():
+                    self.sharded.snapshot_host(self.state, 1)
+
+                # One flat job list, one throttle policy: every entry is
+                # a single proxied call followed by a yield, so live
+                # dispatches wait behind at most ONE trace+lower
+                # (multi-program closures parked the proxy ~18s).
+                jobs: list[tuple[Any, Callable, tuple]] = []
+                buckets = self._reachable_buckets()
+                for i, b in enumerate(buckets):
                     if self._flow_dict is not None:
-                        jobs = [
-                            (("known", b), self._ingest_known_fn, (b,)),
-                            (("new", b), self._ingest_new_fn, (b,)),
-                        ]
+                        jobs.append(
+                            (("known", b), self._ingest_known_fn, (b,))
+                        )
+                        jobs.append(
+                            (("new", b), self._ingest_new_fn, (b,))
+                        )
                     else:
                         packed = bool(self.cfg.transfer_packed)
-                        jobs = [
-                            ((b, packed), self._ingest_fn, (b, packed)),
-                        ]
-                    for key, fn, args in jobs:
-                        if stop is not None and stop.is_set():
-                            return
-                        if key in self._pad_cache:
-                            continue
-                        try:
-                            run_on_device(fn, *args)
-                            n_warmed += 1
-                        except Exception:
-                            n_failed += 1
-                            self.log.exception(
-                                "background warm failed at %s", key
-                            )
+                        jobs.append(
+                            ((b, packed), self._ingest_fn, (b, packed))
+                        )
+                    if i == 0:
+                        # Scrape/window-tick programs right after the
+                        # min bucket: in production the first scrape
+                        # lands 15-30s after boot.
+                        jobs.append(("window close", warm_close, ()))
+                        jobs.append(("snapshot", warm_snap, ()))
+                        jobs.append(("snapshot flat", warm_snap_flat, ()))
+                for key, fn, args in jobs:
+                    if stop is not None and stop.is_set():
+                        return
+                    if key in self._pad_cache:
+                        continue
+                    try:
+                        tk = time.perf_counter()
+                        run_on_device(fn, *args)
+                        n_warmed += 1
+                    except Exception:
+                        n_failed += 1
+                        self.log.exception(
+                            "background warm failed at %s", key
+                        )
+                        continue
+                    # Yield to live traffic: each key's trace+lower
+                    # parks the proxy for seconds; back-to-back keys
+                    # halved the live feed rate for the whole warm.
+                    # Sleeping ~one key-cost between keys caps the
+                    # warm's proxy duty cycle at ~50%.
+                    sl = min(time.perf_counter() - tk, 2.0)
+                    if stop is not None:
+                        stop.wait(sl)
+                    else:
+                        time.sleep(sl)
                 if n_failed:
                     # A failed key means a reachable bucket can still
                     # cold-compile mid-feed — the done event must NOT
@@ -1159,14 +1231,6 @@ class SketchEngine:
             pass
         return stacked
 
-    def _win_readback(self, win) -> dict[str, np.ndarray]:
-        host = np.asarray(jax.device_get(self._win_stack(win)))
-        return {
-            "entropy_bits": host[0],
-            "anomaly": host[1],
-            "zscore": host[2],
-        }
-
     def _publish_window(self, win_host: dict[str, np.ndarray]) -> None:
         self.last_window = win_host
         m = get_metrics()
@@ -1186,26 +1250,60 @@ class SketchEngine:
                 # window must be visible at a 30s scrape.
                 m.anomaly_windows.labels(dimension=dim).inc()
 
-    def _harvest_window(self) -> None:
-        """(proxy thread) Publish the PREVIOUS close's window results.
-        The device_get here is ~free: the async copy started at close
-        time and a whole window interval has passed — the synchronous
-        readback used to park the proxy thread for a full link
-        round-trip behind the queued compute (~70% of proxy time under
-        load, measured via /debug/pprof)."""
-        pending = self._pending_win
-        if pending is None:
-            return
-        self._pending_win = None
-        try:
-            host = np.asarray(jax.device_get(pending))
-            self._publish_window({
-                "entropy_bits": host[0],
-                "anomaly": host[1],
-                "zscore": host[2],
-            })
-        except Exception:
-            self.log.exception("window readback failed")
+    def _ensure_harvest_thread(self) -> None:
+        if self._harvest_thread is None or not self._harvest_thread.is_alive():
+            self._harvest_thread = threading.Thread(
+                target=self._harvest_loop, name="window-harvest",
+                daemon=True,
+            )
+            self._harvest_thread.start()
+
+    def _harvest_loop(self) -> None:
+        """(harvest thread) Block on each closed window's device->host
+        readback and publish its gauges. Runs OFF the device-proxy
+        thread: on backends without async D2H copies (the tunnel) the
+        device_get blocks for a full link round-trip per window, which
+        measured as ~80% of steady-state proxy wall clock when the
+        harvest ran proxy-side — parking every queued step behind
+        scrape-cadence gauge traffic. FIFO order preserves window
+        order."""
+        while True:
+            item = self._harvest_q.get()
+            try:
+                if item is None:
+                    return
+                kind, stacked = item
+                if kind == "zero":
+                    z = np.zeros((3,), np.float32)
+                    self._publish_window({
+                        "entropy_bits": z, "anomaly": z, "zscore": z,
+                    })
+                else:
+                    # fetch_on_device, NOT a direct device_get: every
+                    # JAX call must ride the proxy thread (tunnel
+                    # backend wedges under concurrent runtime access),
+                    # but the queue-wait happens here, off-proxy.
+                    host = fetch_on_device(stacked)
+                    self._publish_window({
+                        "entropy_bits": host[0],
+                        "anomaly": host[1],
+                        "zscore": host[2],
+                    })
+            except Exception:
+                self.log.exception("window readback failed")
+            finally:
+                self._harvest_q.task_done()
+
+    def _harvest_window(self, timeout: float = 30.0) -> None:
+        """Drain pending window readbacks (shutdown / tests): returns
+        once every window enqueued so far has published, or after
+        ``timeout`` (a wedged link must not hang shutdown)."""
+        deadline = time.monotonic() + timeout
+        while (
+            self._harvest_q.unfinished_tasks
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
 
     def _close_window(self) -> None:
         """End the entropy/anomaly window (self-proxying: the body —
@@ -1218,30 +1316,27 @@ class SketchEngine:
         fire-and-forget proxy submission from the dispatch worker, so it
         stays ordered after the step submissions that fed the window.
 
-        The results of THIS close publish at the NEXT window tick
-        (harvest-first): the close dispatches end_window and starts an
-        async device->host copy, but never waits on it — a synchronous
-        readback parks the proxy thread for a link round-trip behind
-        all queued compute, which measured as ~70% of proxy time under
-        load. One window of gauge lag is invisible at any real scrape
-        cadence."""
-        # Publish the previous close's results first (copy long done).
-        self._harvest_window()
+        The close only DISPATCHES end_window and hands the stacked
+        result to the harvest thread — the blocking device->host
+        readback happens there (:meth:`_harvest_loop`), never on the
+        proxy. Gauges publish as soon as the copy lands (typically well
+        inside the window interval)."""
         # Idle fast path: end_window SKIPS empty windows on-device (no
         # flag, no baseline update — AnomalyEWMA.observe active gating),
         # so when nothing arrived since the last close the dispatch +
         # readback round-trip is pure waste; an idle agent then costs
         # zero device traffic between scrapes.
         if self._events_in == self._closed_events_in:
-            m = get_metrics()
-            m.windows_closed.inc()
+            get_metrics().windows_closed.inc()
             # Mirror what a real empty close reports (flag 0, z 0,
             # entropy 0) so a flag raised by the LAST active window
-            # doesn't latch on an idle node.
-            for dim in ("src_ip", "dst_ip", "dst_port"):
-                m.entropy_bits.labels(dimension=dim).set(0.0)
-                m.anomaly_flag.labels(dimension=dim).set(0.0)
-                m.anomaly_zscore.labels(dimension=dim).set(0.0)
+            # doesn't latch on an idle node. Routed through the harvest
+            # queue, NOT set directly: a still-pending active window's
+            # readback publishing after a direct zeroing would re-latch
+            # the stale flag — FIFO through one queue keeps publish
+            # order = close order.
+            self._ensure_harvest_thread()
+            self._harvest_q.put(("zero", None))
             return
         ingested = self._events_in
 
@@ -1258,7 +1353,8 @@ class SketchEngine:
         # raised, the next tick must retry this window, not skip it
         # forever.
         self._closed_events_in = ingested
-        self._pending_win = stacked
+        self._ensure_harvest_thread()
+        self._harvest_q.put(("win", stacked))
         get_metrics().windows_closed.inc()
 
     def _submit_close_window(self) -> None:
@@ -1498,9 +1594,15 @@ class SketchEngine:
                 # Publish the final window's pending readback so
                 # shutdown gauges aren't one window stale.
                 try:
-                    run_on_device(self._harvest_window)
+                    self._harvest_window()
                 except Exception:
                     self.log.exception("final window harvest failed")
+            # Retire the harvest thread (it closes over self: left
+            # parked on the queue it would pin the engine object graph
+            # across restart cycles).
+            if self._harvest_thread is not None:
+                self._harvest_q.put(None)
+                self._harvest_thread.join(timeout=5.0)
 
     # -- scrape-time readout -----------------------------------------
     def snapshot(self, max_age_s: float = 0.5) -> dict[str, Any]:
@@ -1523,18 +1625,28 @@ class SketchEngine:
                 ):
                     return self._snap_cache
 
-            def snap():
+            def snap_dispatch():
                 # ONE device->host transfer for the whole tree (leaves
                 # are concatenated on device): per-leaf readback paid a
                 # full link round trip per array — measured 2.7-21s at
                 # production shapes on a congested link vs the <1s
-                # scrape budget.
+                # scrape budget. Only the DISPATCH runs on the proxy
+                # (ordered against in-flight steps; later donating
+                # steps execute after it on the device stream); the
+                # queue-wait for the result happens on THIS thread via
+                # fetch_on_device's readiness polling, so scrape/GC
+                # traffic never parks the step pipeline — while every
+                # actual JAX call still rides the proxy (tunnel backend
+                # wedges under concurrent runtime access).
                 with self._state_lock:
-                    return self.sharded.snapshot_host(
+                    return self.sharded.snapshot_flat_dispatch(
                         self.state, int(time.time())
                     )
 
-            host = run_on_device(snap)
+            flat_dev = run_on_device(snap_dispatch)
+            flat_host = fetch_on_device(flat_dev)
+            host = self.sharded.snapshot_flat_finish(flat_host)
+            get_metrics().readback_bytes.inc(int(flat_host.nbytes))
             host["steps"] = self._steps
             host["events_in"] = self._events_in
             with self._snap_lock:

@@ -100,6 +100,10 @@ class Metrics:
             buckets=[1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0, 3.0],
         )
         self.transfer_bytes = c(mn.TRANSFER_BYTES, [])
+        # Device->host bytes (snapshot readbacks): on a serialized
+        # tunnel link they share the same pipe as transfer_bytes, so
+        # link-utilization math must sum both directions.
+        self.readback_bytes = c(mn.READBACK_BYTES, [])
 
 
 _singleton: Metrics | None = None

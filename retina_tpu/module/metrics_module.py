@@ -164,10 +164,15 @@ class MetricsModule:
 
     def start(self, stop: threading.Event) -> None:
         # Adaptive cadence: the 1 s module interval
-        # (metrics_module.go:37) assumes snapshot readback is cheap. On a
-        # slow host<->device link a fresh snapshot can cost seconds; keep
-        # the publisher's duty cycle <= ~50% so it never monopolizes the
-        # device transport against the feed path.
+        # (metrics_module.go:37) assumes snapshot readback is cheap. On
+        # a slow host<->device link a fresh snapshot (~1.4 MB D2H)
+        # costs real link time that the feed path's H2D wire shares;
+        # back off to 4x cost so gauge freshness degrades before feed
+        # throughput does — but never beyond 5 s: under sustained load
+        # the snapshot's cost is mostly FIFO queueing behind in-flight
+        # steps (not link bytes), and unbounded backoff turned
+        # pod-gauge staleness into 12-15 s. On a fast link cost is
+        # milliseconds and the cadence stays 1 s.
         while not stop.is_set():
             t0 = time.perf_counter()
             try:
@@ -175,4 +180,4 @@ class MetricsModule:
             except Exception:
                 self._log.exception("publish cycle failed")
             cost = time.perf_counter() - t0
-            stop.wait(max(PUBLISH_INTERVAL_S, cost))
+            stop.wait(max(PUBLISH_INTERVAL_S, min(4 * cost, 5.0)))

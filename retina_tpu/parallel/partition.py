@@ -74,14 +74,18 @@ class ShardedBatch:
 
 
 def _next_bucket(n: int) -> int:
-    """Smallest m * 2^k >= n with mantissa m in {4,5,6,7}: transfer
-    shapes quantize to within 25% of the payload (vs up to 100% for pure
-    powers of two) while keeping the distinct-shape count — and thus the
-    engine's per-shape ingest jits — small."""
+    """Smallest m * 2^k >= n with mantissa m in {4,6}: transfer shapes
+    quantize to within 50% of the payload (vs up to 100% for pure
+    powers of two) while keeping the distinct-shape count — and thus
+    the engine's per-shape ingest jits — small. Two shapes per octave
+    (was four, mantissa {4,5,6,7}): each grid key costs seconds of
+    trace+lower on the device-proxy thread at boot warm, and halving
+    the grid halved that for a bounded ~17% average padding cost on a
+    wire that is already <0.5 B/event."""
     if n <= 4:
         return max(n, 1)
-    k = (n - 1).bit_length() - 3  # so that 4*2^k <= n-1 < 8*2^k... scaled
-    step = 1 << k
+    k = (n - 1).bit_length() - 3  # so that 4*2^k <= n-1 < 8*2^k
+    step = 1 << (k + 1)  # multiples of 2^(k+1): mantissa 4 or 6
     return ((n + step - 1) // step) * step
 
 

@@ -24,7 +24,10 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, TypeVar
+
+import numpy as np
 
 T = TypeVar("T")
 
@@ -93,6 +96,29 @@ def submit_on_device(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
         return
     q = _ensure_thread()
     q.put((fn, args, kwargs, [], threading.Event()))
+
+
+def fetch_on_device(arr: Any, poll_s: float = 0.01) -> Any:
+    """Device->host readback that blocks only the CALLER.
+
+    A plain ``np.asarray(arr)`` on the proxy thread parks it for the
+    full wait (queued compute ahead of ``arr`` plus the D2H copy) —
+    measured as ~80% of proxy wall clock when window/snapshot readbacks
+    ran proxy-side under load. Doing the asarray on the caller's thread
+    instead violates this module's single-thread invariant (concurrent
+    device_get beside proxy dispatches wedges the tunnel backend).
+
+    This does neither: the caller polls ``arr.is_ready()`` through
+    short proxied calls (serviced between queued dispatches in ~µs),
+    sleeping off-proxy between polls, and only when the computation has
+    finished does the proxy run the asarray — which then costs just the
+    D2H bytes, not the queue wait. Every JAX touch stays on the proxy
+    thread."""
+    check = getattr(arr, "is_ready", None)
+    if check is not None:
+        while not run_on_device(check):
+            time.sleep(poll_s)
+    return run_on_device(np.asarray, arr)
 
 
 def fence(timeout: float | None = None) -> bool:

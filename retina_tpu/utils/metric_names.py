@@ -84,6 +84,7 @@ WINDOWS_CLOSED = PREFIX + "tpu_windows_closed"
 COMBINE_RATIO = PREFIX + "host_combine_ratio"
 TRANSFER_SECONDS = PREFIX + "tpu_transfer_seconds"
 TRANSFER_BYTES = PREFIX + "tpu_transfer_bytes"
+READBACK_BYTES = PREFIX + "tpu_readback_bytes"
 
 # Label keys (reference pkg/utils/metric_names.go label constants).
 L_DIRECTION = "direction"

@@ -363,6 +363,9 @@ def test_idle_window_close_skips_device_and_clears_gauges():
     eng._close_window()
     eng._close_window()
     assert calls["n"] == 1  # idle ticks: no device call
+    # Publishes (including the idle zeroing) ride the harvest queue in
+    # close order; drain it before reading the gauges.
+    eng._harvest_window()
     assert m.anomaly_flag.labels(
         dimension="src_ip")._value.get() == 0.0  # cleared, not latched
     # Traffic resumes: the close runs again.
@@ -427,12 +430,9 @@ def test_pipelined_window_close_ordered_with_steps():
     t.join(5.0)
     # close directly (loop window is 10s so it never fired): entropy of
     # the fed window must be non-zero — steps preceded the close. The
-    # close publishes at the NEXT tick (lagged readback), so harvest
-    # explicitly.
-    from retina_tpu.utils.device_proxy import run_on_device
-
+    # readback publishes on the harvest thread; drain it explicitly.
     eng._close_window()
-    run_on_device(eng._harvest_window)
+    eng._harvest_window()
     assert float(eng.last_window["entropy_bits"][0]) > 0.0
 
 
