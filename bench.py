@@ -450,6 +450,22 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     proxy_s0 = _proxy_seconds()
     t_win0 = time.monotonic()
     windows = [measure_window() for _ in range(3)]
+    # The tunnel stalls in 10-30s episodes that can zero out whole
+    # windows (observed: [13.7M, 0, 0, 16.5M, 7.0M]; a 90s no-scrape
+    # profile run confirmed the proxy parked inside the remote execute
+    # RPC during them — outage, not code). A stalled window is weather,
+    # not capability — but dropping it silently would be dishonest, so
+    # measure up to four EXTRA windows instead (median of 7 tolerates 3
+    # stalled ones) and let the median run over everything measured;
+    # all windows are attached to the result either way.
+    while (
+        len(windows) < 7
+        and min(w["rate"] for w in windows)
+        < 0.25 * max(w["rate"] for w in windows)
+    ):
+        log("e2e: stall-episode window detected; measuring an extra "
+            "window")
+        windows.append(measure_window())
     # Steady-state proxy occupancy over EXACTLY the measured span (the
     # whole-run sums would fold boot compiles and warm waits in).
     proxy_share = (_proxy_seconds() - proxy_s0) / max(

@@ -207,6 +207,12 @@ class SketchEngine:
         # shut the thread down.
         self._harvest_q: queue_mod.Queue = queue_mod.Queue()
         self._harvest_thread: threading.Thread | None = None
+        # Set by the shutdown path after the final drain: a straggler
+        # (e.g. a warm_close racing stop) must not resurrect the
+        # thread, or it would park on the queue forever pinning the
+        # engine object graph.
+        self._harvest_retired = False
+        self._warm_thread: threading.Thread | None = None
         self.last_window: dict[str, np.ndarray] = {}
         self._state_lock = threading.Lock()
         self.started = threading.Event()
@@ -495,8 +501,10 @@ class SketchEngine:
                     # parks the proxy for seconds; back-to-back keys
                     # halved the live feed rate for the whole warm.
                     # Sleeping ~one key-cost between keys caps the
-                    # warm's proxy duty cycle at ~50%.
-                    sl = min(time.perf_counter() - tk, 2.0)
+                    # warm's proxy duty cycle at ~50% for keys up to
+                    # the 10s cap (beyond it — pathological compiles —
+                    # finishing the warm wins over fairness).
+                    sl = min(time.perf_counter() - tk, 10.0)
                     if stop is not None:
                         stop.wait(sl)
                     else:
@@ -523,6 +531,7 @@ class SketchEngine:
         t = threading.Thread(
             target=_warm, name="engine-bucket-warm", daemon=True
         )
+        self._warm_thread = t
         t.start()
         return t
 
@@ -1251,6 +1260,8 @@ class SketchEngine:
                 m.anomaly_windows.labels(dimension=dim).inc()
 
     def _ensure_harvest_thread(self) -> None:
+        if self._harvest_retired:
+            return
         if self._harvest_thread is None or not self._harvest_thread.is_alive():
             self._harvest_thread = threading.Thread(
                 target=self._harvest_loop, name="window-harvest",
@@ -1599,7 +1610,14 @@ class SketchEngine:
                     self.log.exception("final window harvest failed")
             # Retire the harvest thread (it closes over self: left
             # parked on the queue it would pin the engine object graph
-            # across restart cycles).
+            # across restart cycles). Join the background warm FIRST —
+            # a warm key in flight past its stop check could otherwise
+            # enqueue one more window after the sentinel; the retired
+            # flag then stops _ensure_harvest_thread from resurrecting
+            # the thread for any straggler that still slips through.
+            if self._warm_thread is not None:
+                self._warm_thread.join(timeout=30.0)
+            self._harvest_retired = True
             if self._harvest_thread is not None:
                 self._harvest_q.put(None)
                 self._harvest_thread.join(timeout=5.0)

@@ -426,13 +426,15 @@ def test_pipelined_window_close_ordered_with_steps():
         if int(eng.snapshot(max_age_s=0)["totals"][0]) == 1000:
             break
         time.sleep(0.05)
-    stop.set()
-    t.join(5.0)
-    # close directly (loop window is 10s so it never fired): entropy of
-    # the fed window must be non-zero — steps preceded the close. The
-    # readback publishes on the harvest thread; drain it explicitly.
+    # close directly while the engine is live (its loop window is 10s
+    # so it never fired): entropy of the fed window must be non-zero —
+    # steps preceded the close. The readback publishes on the harvest
+    # thread; drain it explicitly. Must run BEFORE stop: engine
+    # shutdown retires the harvest thread.
     eng._close_window()
     eng._harvest_window()
+    stop.set()
+    t.join(5.0)
     assert float(eng.last_window["entropy_bits"][0]) > 0.0
 
 
