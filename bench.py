@@ -458,11 +458,14 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     # measure up to four EXTRA windows instead (median of 7 tolerates 3
     # stalled ones) and let the median run over everything measured;
     # all windows are attached to the result either way.
-    while (
-        len(windows) < 7
-        and min(w["rate"] for w in windows)
-        < 0.25 * max(w["rate"] for w in windows)
-    ):
+    def _stall_suspected() -> bool:
+        rates = [w["rate"] for w in windows]
+        # max()==0 means EVERY window so far was stalled — the
+        # min<0.25*max test is vacuously false there, which would
+        # report 0 ev/s as capability for a healthy build.
+        return max(rates) == 0 or min(rates) < 0.25 * max(rates)
+
+    while len(windows) < 7 and _stall_suspected():
         log("e2e: stall-episode window detected; measuring an extra "
             "window")
         windows.append(measure_window())

@@ -27,6 +27,7 @@ from retina_tpu.e2e.steps import (
     ScrapeAssert,
     StopAgent,
     WaitReady,
+    WaitWarm,
 )
 
 __all__ = [
@@ -43,4 +44,5 @@ __all__ = [
     "ScrapeAssert",
     "StopAgent",
     "WaitReady",
+    "WaitWarm",
 ]

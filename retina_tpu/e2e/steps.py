@@ -107,6 +107,39 @@ class WaitReady(Step):
         raise StepFailed("readyz never turned 200")
 
 
+class WaitWarm(Step):
+    """Wait for the background jit warm (bucket grid + scrape keys) to
+    finish. Scenarios that assert TIME-SENSITIVE behavior (e.g. one
+    anomaly window per wall-clock window) need this: during the warm,
+    queued window closes execute in bursts between warm-key compiles,
+    folding several wall-clock windows into one active window — correct
+    for an agent (documented boot behavior) but non-deterministic for a
+    test."""
+
+    name = "wait-warm"
+
+    def __init__(self, timeout_s: float = 120.0):
+        self.timeout_s = timeout_s
+
+    def run(self, ctx: dict[str, Any]) -> None:
+        eng = ctx["daemon"].cm.engine
+        deadline = time.monotonic() + self.timeout_s
+        while time.monotonic() < deadline:
+            if eng.bucket_warm_failed.is_set():
+                # Fail fast with the real cause: the warm terminated
+                # with failed keys (logged by the engine), so the done
+                # event will never fire.
+                raise StepFailed(
+                    "bucket grid warm terminated with failed key(s) — "
+                    "see 'background warm failed at' in the agent log"
+                )
+            if eng.bucket_warm_done.wait(0.2):
+                return
+        raise StepFailed(
+            f"bucket grid warm not done in {self.timeout_s}s"
+        )
+
+
 class RegisterPods(Step):
     """Publish pod identities into the cache (the k8s watcher seam)."""
 

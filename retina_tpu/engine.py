@@ -217,8 +217,14 @@ class SketchEngine:
         self._state_lock = threading.Lock()
         self.started = threading.Event()
         # Set once start_background_warm has every reachable bucket key
-        # compiled (tests and shutdown fences).
+        # compiled (tests and shutdown fences). bucket_warm_failed is
+        # its terminal-failure sibling: set when the warm finished but
+        # one or more keys failed (the agent stays up; those buckets
+        # cold-compile inline) so waiters can fail fast with the real
+        # cause instead of timing out on a done-event that will never
+        # come.
         self.bucket_warm_done = threading.Event()
+        self.bucket_warm_failed = threading.Event()
         self._steps = 0
         self._events_in = 0
         self._closed_events_in = 0
@@ -264,9 +270,27 @@ class SketchEngine:
                 if old.get(ip) != idx:
                     self._ident_host.insert(ip, idx)
             self._ident_dict = new
-            # Device upload on the proxy thread (all JAX interaction is
-            # single-threaded through it; utils/device_proxy.py).
-            self.ident = run_on_device(self._ident_host.to_device)
+
+        # Upload AND swap inside one proxied closure: dispatches capture
+        # self.ident at proxy-execution time, so FIFO order on the
+        # proxy queue is exactly the visibility order — an identity
+        # update enqueued before a batch's execution is guaranteed
+        # visible to that batch, even when compiles/warm keys delay the
+        # queue by seconds. The packed table is SNAPSHOTTED here, at
+        # call time: uploading the live shared _ident_host from the
+        # closure would let a later-enqueued update's host mutations
+        # leak into this earlier-enqueued upload (visibility skew in
+        # the other direction).
+        with self._ident_lock:
+            packed = self._ident_host.table.copy()
+            seed = self._ident_host.seed
+
+        def apply_ident():
+            dev = IdentityMap(table=jnp.asarray(packed), seed=seed)
+            with self._ident_lock:
+                self.ident = dev
+
+        run_on_device(apply_ident)
 
     def update_filter_ips(self, ips: set[int]) -> None:
         # Build the cuckoo table on the CALLING thread (pure numpy, O(n)
@@ -290,9 +314,19 @@ class SketchEngine:
             live = live[: host.capacity]
         for ip in live:
             host.insert(ip, 1)
-        fmap = run_on_device(host.to_device)
-        with self._ident_lock:
-            self.filter_map = fmap
+
+        # Upload AND swap in one proxied closure (see update_identities
+        # above): a filter update enqueued before a batch executes is
+        # visible to that batch — the pre-r5 swap-after-return left a
+        # window where a one-shot traffic burst dispatched behind a
+        # slow proxy queue was filtered by the OLD (possibly empty)
+        # map, dropping it silently.
+        def apply_filter():
+            fmap = host.to_device()
+            with self._ident_lock:
+                self.filter_map = fmap
+
+        run_on_device(apply_filter)
 
     def set_apiserver_ips(self, ips: list[int]) -> None:
         self.apiserver_ip = ips[0] if ips else 0
@@ -517,6 +551,7 @@ class SketchEngine:
                         "bucket grid warm incomplete: %d key(s) failed",
                         n_failed,
                     )
+                    self.bucket_warm_failed.set()
                     return
                 self.bucket_warm_done.set()
                 if n_warmed:
@@ -830,9 +865,6 @@ class SketchEngine:
         from retina_tpu.parallel.wire import batch_ts_base, pack_records
 
         t_d0 = time.monotonic()
-        with self._ident_lock:
-            ident = self.ident
-            fmap = self.filter_map
         m = get_metrics()
         lost = sb.lost
         D = self.n_devices
@@ -964,6 +996,17 @@ class SketchEngine:
                     )
                     return
             self._device_consts()
+            # Identity/filter tables captured at proxy-EXECUTION time,
+            # not dispatch-build time: update_identities /
+            # update_filter_ips swap them inside proxied closures, so
+            # FIFO queue order == visibility order — a table update
+            # enqueued before this batch is guaranteed applied to it
+            # even when warm-key compiles delay the queue by seconds
+            # (build-time capture silently filtered a one-shot burst
+            # with the pre-update map).
+            with self._ident_lock:
+                ident = self.ident
+                fmap = self.filter_map
             table = self._ensure_desc_table()
             if record_metrics:
                 # Wire accounting AFTER the epoch check: a dropped
@@ -1123,9 +1166,6 @@ class SketchEngine:
                     return
                 raise
             return
-        with self._ident_lock:
-            ident = self.ident
-            fmap = self.filter_map
         m = get_metrics()
         if sb.lost and record_metrics:
             m.lost_events.labels(stage="partition", plugin="engine").inc(sb.lost)
@@ -1155,6 +1195,11 @@ class SketchEngine:
 
         def xfer_and_step():
             self._device_consts()
+            # Execution-time capture — see _dispatch_flowdict: proxy
+            # FIFO order is the table-visibility order.
+            with self._ident_lock:
+                ident = self.ident
+                fmap = self.filter_map
             t_x0 = time.perf_counter()
             # One batched put (wire + meta): separate puts each pay a
             # client round-trip on the tunnel backend.

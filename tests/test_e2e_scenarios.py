@@ -19,6 +19,7 @@ from retina_tpu.e2e import (
     Runner,
     ScrapeAssert,
     WaitReady,
+    WaitWarm,
 )
 from retina_tpu.e2e.steps import small_agent_config
 from retina_tpu.events.schema import (
@@ -279,6 +280,10 @@ def test_scenario_ddos_entropy_anomaly():
     Runner(Job("ddos-anomaly-scenario").add(
         BootAgent(cfg),
         WaitReady(),
+        # This scenario asserts one-anomaly-window-per-wall-clock-window
+        # timing; during the background warm, queued closes execute in
+        # bursts and fold windows (see WaitWarm docstring).
+        WaitWarm(),
         RegisterPods(PODS),
         DriveWindows(13, attack=False),  # EWMA warmup >= min_windows
         # No anomalous window during warmup (idle windows are skipped,
