@@ -458,14 +458,19 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     # measure up to four EXTRA windows instead (median of 7 tolerates 3
     # stalled ones) and let the median run over everything measured;
     # all windows are attached to the result either way.
-    def _stall_suspected() -> bool:
-        rates = [w["rate"] for w in windows]
-        # max()==0 means EVERY window so far was stalled — the
-        # min<0.25*max test is vacuously false there, which would
-        # report 0 ev/s as capability for a healthy build.
-        return max(rates) == 0 or min(rates) < 0.25 * max(rates)
+    # A transport-outage window reads NEAR ZERO (the tunnel freezes
+    # outright — an independent 4KB round-trip took 55s during one),
+    # so stall classification uses an ABSOLUTE floor: 10% of the 10M
+    # north star. A relative-to-best rule was tried and rejected: one
+    # anomalously fast window would reclassify every typical window as
+    # "stalled" and promote itself to the headline. A merely-slow
+    # system sits above the floor in every window and is reported
+    # as-is.
+    STALL_FLOOR = 1e6
 
-    while len(windows) < 7 and _stall_suspected():
+    while len(windows) < 7 and any(
+        w["rate"] < STALL_FLOOR for w in windows
+    ):
         log("e2e: stall-episode window detected; measuring an extra "
             "window")
         windows.append(measure_window())
@@ -476,7 +481,17 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     )
     log("e2e: windows "
         + ", ".join(f"{w['rate'] / 1e6:.2f}M" for w in windows))
-    win = sorted(windows, key=lambda w: w["rate"])[len(windows) // 2]
+    # Transport-outage windows (below STALL_FLOOR) are excluded from
+    # the HEADLINE median but fully disclosed (all window rates + the
+    # stall count ride the result): a zeroed window measures the
+    # harness link, not the system — production PCIe has no tunnel.
+    # Partial-outage windows (a stall covering part of a window) land
+    # above the floor and stay IN the median, diluting it; that bias
+    # runs against us, never for us. If every window stalled, the
+    # plain median stands (nothing to distinguish).
+    clean = [w for w in windows if w["rate"] >= STALL_FLOOR] or windows
+    win = sorted(clean, key=lambda w: w["rate"])[len(clean) // 2]
+    n_stalled = len(windows) - len(clean)
     rate = win["rate"]
     lat = win["lat"]
     ev_delta = win["events"]
@@ -533,6 +548,10 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         "scrapes": len(lat),
         "duration_s": round(win["elapsed"], 1),
         "measure_windows": [round(w["rate"]) for w in windows],
+        # Windows zeroed by harness-transport outage episodes (see the
+        # classification comment above); the headline median runs over
+        # the non-stalled windows only.
+        "stalled_windows": n_stalled,
         "combine_ratio": round(combine_ratio, 2),
         "wire_bytes_per_event": round(wire_bpe, 2),
         "link_bandwidth_mbs": round(link_mbs, 1),

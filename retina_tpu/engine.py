@@ -795,8 +795,17 @@ class SketchEngine:
                 PACKED_FIELDS, unpack_records_device,
             )
 
-            id_bits = jnp.uint32(self._fd_id_bits)
-            id_mask = jnp.uint32((1 << self._fd_id_bits) - 1)
+            # HOST scalars (np, not jnp), deliberately: a jnp scalar
+            # here becomes a committed DEVICE array captured as a
+            # trace-closure constant, and lowering such a constant
+            # does a device->host _value copy — which, issued from a
+            # background-warm lower() while the feed keeps the device
+            # queue busy, starved for minutes on the tunnel backend and
+            # froze the whole proxy (observed: every measure window at
+            # 0 ev/s). np scalars lower to MLIR literals with zero
+            # device traffic.
+            id_bits = np.uint32(self._fd_id_bits)
+            id_mask = np.uint32((1 << self._fd_id_bits) - 1)
             out_sh = (
                 (self._rec_sharding,) * n_win,
                 (self._rec_sharding,) * n_win,

@@ -101,7 +101,7 @@ class IdentityMap:
         ).astype(jnp.int32)
         r1 = self.table[h1]  # (B, 2)
         r2 = self.table[h2]
-        out = jnp.where(r1[:, 0] == ip, r1[:, 1], jnp.uint32(0))
+        out = jnp.where(r1[:, 0] == ip, r1[:, 1], np.uint32(0))
         return jnp.where(r2[:, 0] == ip, r2[:, 1], out)
 
 

@@ -24,6 +24,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from retina_tpu.events.schema import (
     F,
@@ -50,9 +51,9 @@ def _sum64(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     the four 8-bit byte planes keeps every partial sum < 2^25 * B exact in
     u32, then the planes are recombined with explicit carries.
     """
-    p0 = jnp.sum(x & jnp.uint32(0xFF)).astype(jnp.uint32)
-    p1 = jnp.sum((x >> 8) & jnp.uint32(0xFF)).astype(jnp.uint32)
-    p2 = jnp.sum((x >> 16) & jnp.uint32(0xFF)).astype(jnp.uint32)
+    p0 = jnp.sum(x & np.uint32(0xFF)).astype(jnp.uint32)
+    p1 = jnp.sum((x >> 8) & np.uint32(0xFF)).astype(jnp.uint32)
+    p2 = jnp.sum((x >> 16) & np.uint32(0xFF)).astype(jnp.uint32)
     p3 = jnp.sum(x >> 24).astype(jnp.uint32)
     hi = (p1 >> 24) + (p2 >> 16) + (p3 >> 8)
     lo = p0
@@ -232,11 +233,11 @@ class TelemetryPipeline:
         src_ip, dst_ip = col(F.SRC_IP), col(F.DST_IP)
         ports, meta = col(F.PORTS), col(F.META)
         proto = meta >> 24
-        tcp_flags = (meta >> 16) & jnp.uint32(0xFF)
-        direction = (meta >> 4) & jnp.uint32(0xF)
+        tcp_flags = (meta >> 16) & np.uint32(0xFF)
+        direction = (meta >> 4) & np.uint32(0xF)
         bytes_, packets = col(F.BYTES), col(F.PACKETS)
         verdict = col(F.VERDICT)
-        reason = jnp.minimum(col(F.DROP_REASON), jnp.uint32(c.n_drop_reasons - 1))
+        reason = jnp.minimum(col(F.DROP_REASON), np.uint32(c.n_drop_reasons - 1))
         ev_type = col(F.EVENT_TYPE)
 
         is_fwd = mask & (verdict == VERDICT_FORWARDED)
@@ -277,7 +278,7 @@ class TelemetryPipeline:
         # ---- conntrack sampling (before the sketches: low aggregation
         # gates sketch updates on the report decisions) ----
         ct = state.conntrack
-        n_reports = jnp.uint32(0)
+        n_reports = np.uint32(0)
         report = jnp.zeros((b,), bool)
         rep_pkts = jnp.zeros((b,), jnp.uint32)
         rep_bytes = jnp.zeros((b,), jnp.uint32)
@@ -295,7 +296,7 @@ class TelemetryPipeline:
         # and the pass count (the measured TPU cost driver) drops from 17
         # scatters to 4.
         P = c.n_pods
-        local_pod_c = jnp.minimum(local_pod, jnp.uint32(P - 1))
+        local_pod_c = jnp.minimum(local_pod, np.uint32(P - 1))
         pf = (
             state.pod_forward.reshape(P * 2, 2)
             .at[local_pod_c * 2 + dir_idx]
@@ -304,7 +305,7 @@ class TelemetryPipeline:
         )
 
         R = c.n_drop_reasons
-        drop_idx = jnp.where(is_drop, local_pod_c * R + reason, jnp.uint32(P * R))
+        drop_idx = jnp.where(is_drop, local_pod_c * R + reason, np.uint32(P * R))
         pd = (
             state.pod_drop.reshape(P * R, 2)
             .at[drop_idx]
@@ -331,13 +332,13 @@ class TelemetryPipeline:
             axis=1,
         )
         ptf = state.pod_tcpflags.at[
-            jnp.where(is_tcp, local_pod_c, jnp.uint32(P))
+            jnp.where(is_tcp, local_pod_c, np.uint32(P))
         ].add(flag_rows, mode="drop")
 
         Q = c.n_dns_qtypes
-        qtype = jnp.minimum(col(F.DNS) >> 16, jnp.uint32(Q - 1))
+        qtype = jnp.minimum(col(F.DNS) >> 16, np.uint32(Q - 1))
         is_dns = is_dns_req | is_dns_resp
-        dns_idx = jnp.where(is_dns, local_pod_c * Q + qtype, jnp.uint32(P * Q))
+        dns_idx = jnp.where(is_dns, local_pod_c * Q + qtype, np.uint32(P * Q))
         # Every count below weights by F.PACKETS (1 for per-packet events,
         # N for combined/pre-aggregated rows) so host-side RLE combining
         # (parallel/combine.py) is exactly lossless.
@@ -355,7 +356,7 @@ class TelemetryPipeline:
         )
 
         pret = state.pod_retrans.at[
-            jnp.where(is_retrans, local_pod_c, jnp.uint32(P))
+            jnp.where(is_retrans, local_pod_c, np.uint32(P))
         ].add(w_retrans, mode="drop")
 
         # Node counters are plain masked reductions (no scatter needed):
@@ -395,7 +396,7 @@ class TelemetryPipeline:
         hll_reason = state.hll_src_per_reason.update([src_ip], reason, is_drop)
         hll_pod = state.hll_src_per_pod.update(
             [src_ip],
-            jnp.minimum(dst_pod, jnp.uint32(c.n_pods - 1)),
+            jnp.minimum(dst_pod, np.uint32(c.n_pods - 1)),
             is_ingress & sk_mask,
         )
 
@@ -408,7 +409,7 @@ class TelemetryPipeline:
         ent = ent.update([src_ip], jnp.zeros_like(src_ip), ones)
         ent = ent.update([dst_ip], jnp.ones_like(src_ip), ones)
         ent = ent.update(
-            [ports & jnp.uint32(0xFFFF)], jnp.full_like(src_ip, 2), ones
+            [ports & np.uint32(0xFFFF)], jnp.full_like(src_ip, 2), ones
         )
 
         # ---- apiserver latency (reference latency.go:286-301: match
@@ -433,13 +434,13 @@ class TelemetryPipeline:
             # TSecr (normal TCP) must not re-record the sample, and a
             # recycled TSval hours later must not match a stale entry.
             lat_key = lat_key.at[jnp.where(hit, slot_in, L)].set(
-                jnp.uint32(0), mode="drop"
+                np.uint32(0), mode="drop"
             )
             # exponential buckets: bucket = floor(log2(rtt_ms + 1)).
             bug = jnp.floor(
                 jnp.log2(rtt.astype(jnp.float32) + 1.0)
             ).astype(jnp.uint32)
-            bug = jnp.minimum(bug, jnp.uint32(c.latency_buckets - 1))
+            bug = jnp.minimum(bug, np.uint32(c.latency_buckets - 1))
             lat_hist = lat_hist.at[jnp.where(hit, bug, c.latency_buckets)].add(
                 jnp.where(hit, 1, 0).astype(jnp.uint32), mode="drop"
             )
@@ -473,7 +474,7 @@ class TelemetryPipeline:
                 jnp.sum(w_dns_resp).astype(jnp.uint32),
                 jnp.sum(w_retrans).astype(jnp.uint32),
                 n_reports,
-                jnp.uint32(0),
+                np.uint32(0),
             ]
         )
 

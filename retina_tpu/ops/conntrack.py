@@ -150,7 +150,7 @@ class ConntrackTable:
         # Order-independent key: same connection regardless of direction;
         # ports break the tie for hairpin flows where src_ip == dst_ip.
         sp = ports >> 16
-        dp = ports & jnp.uint32(0xFFFF)
+        dp = ports & np.uint32(0xFFFF)
         fwd_order = (src_ip < dst_ip) | ((src_ip == dst_ip) & (sp <= dp))
         a_ip = jnp.where(fwd_order, src_ip, dst_ip)
         b_ip = jnp.where(fwd_order, dst_ip, src_ip)
@@ -162,13 +162,13 @@ class ConntrackTable:
         slot = reduce_range(fp_lo ^ fp_hi, s)
 
         # Masked rows sort to the end (max key) and carry a cleared mask bit.
-        k_lo = jnp.where(mask, fp_lo, jnp.uint32(0xFFFFFFFF))
-        k_hi = jnp.where(mask, fp_hi, jnp.uint32(0xFFFFFFFF))
-        is_tcp_ev = proto == jnp.uint32(6)
-        interesting = (tcp_flags & jnp.uint32(TCP_SYN | TCP_FIN | TCP_RST)) > 0
+        k_lo = jnp.where(mask, fp_lo, np.uint32(0xFFFFFFFF))
+        k_hi = jnp.where(mask, fp_hi, np.uint32(0xFFFFFFFF))
+        is_tcp_ev = proto == np.uint32(6)
+        interesting = (tcp_flags & np.uint32(TCP_SYN | TCP_FIN | TCP_RST)) > 0
         # attr: flags(0-7) | tcp(8) | src_is_a(9) | mask(10) | interesting(11)
         attr = (
-            (tcp_flags & jnp.uint32(0xFF))
+            (tcp_flags & np.uint32(0xFF))
             | (is_tcp_ev.astype(jnp.uint32) << 8)
             | (fwd_order.astype(jnp.uint32) << 9)
             | (mask.astype(jnp.uint32) << 10)
@@ -206,23 +206,23 @@ class ConntrackTable:
         vrow = self.vals[gi]  # (B, 4)
         same_conn = (krow[:, 0] == sk_lo) & (krow[:, 1] == sk_hi)
         meta = vrow[:, 0]
-        seen16 = meta & jnp.uint32(0xFFFF)
-        rep14 = (meta >> 16) & jnp.uint32(0x3FFF)
+        seen16 = meta & np.uint32(0xFFFF)
+        rep14 = (meta >> 16) & np.uint32(0x3FFF)
         init_a = ((meta >> 30) & 1).astype(bool)
 
-        now16 = (now_s & jnp.uint32(0xFFFF)).astype(jnp.uint32)
-        now14 = (now_s & jnp.uint32(0x3FFF)).astype(jnp.uint32)
+        now16 = (now_s & np.uint32(0xFFFF)).astype(jnp.uint32)
+        now14 = (now_s & np.uint32(0x3FFF)).astype(jnp.uint32)
         lifetime = jnp.where(
-            s_tcp, jnp.uint32(CT_TCP_LIFETIME), jnp.uint32(CT_NON_TCP_LIFETIME)
+            s_tcp, np.uint32(CT_TCP_LIFETIME), np.uint32(CT_NON_TCP_LIFETIME)
         )
-        idle = (now16 - seen16) & jnp.uint32(0xFFFF)
+        idle = (now16 - seen16) & np.uint32(0xFFFF)
         expired = (idle > lifetime) & (
-            idle <= jnp.uint32(0xFFFF - CLOCK_SKEW_SLACK)
+            idle <= np.uint32(0xFFFF - CLOCK_SKEW_SLACK)
         )
         is_new = (~same_conn) | expired
-        rep_delta = (now14 - rep14) & jnp.uint32(0x3FFF)
-        interval_up = (rep_delta >= jnp.uint32(CT_REPORT_INTERVAL)) & (
-            rep_delta <= jnp.uint32(0x3FFF - CLOCK_SKEW_SLACK)
+        rep_delta = (now14 - rep14) & np.uint32(0x3FFF)
+        interval_up = (rep_delta >= np.uint32(CT_REPORT_INTERVAL)) & (
+            rep_delta <= np.uint32(0x3FFF - CLOCK_SKEW_SLACK)
         )
         report = last & (seg_int | is_new | (same_conn & interval_up))
         is_reply = s_mask & same_conn & (~expired) & (init_a != s_src_is_a)
@@ -248,7 +248,7 @@ class ConntrackTable:
         )
         acc_pkts = jnp.where(report, 0, res_pkts + seg_pkts)
         acc_bytes = jnp.where(report, 0, res_bytes + seg_bytes)
-        eff = jnp.where(last, s_slot, jnp.uint32(s))
+        eff = jnp.where(last, s_slot, np.uint32(s))
         new_keys = self.keys.at[eff].set(
             jnp.stack([sk_lo, sk_hi], axis=1), mode="drop"
         )
@@ -290,13 +290,13 @@ class ConntrackTable:
         """
         live = (self.keys[:, 0] | self.keys[:, 1]) != 0
         meta = self.vals[:, 0]
-        seen16 = meta & jnp.uint32(0xFFFF)
+        seen16 = meta & np.uint32(0xFFFF)
         is_tcp = (meta >> 31) > 0
         lifetime = jnp.where(
-            is_tcp, jnp.uint32(CT_TCP_LIFETIME), jnp.uint32(CT_NON_TCP_LIFETIME)
+            is_tcp, np.uint32(CT_TCP_LIFETIME), np.uint32(CT_NON_TCP_LIFETIME)
         )
-        idle = (jnp.uint32(now_s) - seen16) & jnp.uint32(0xFFFF)
+        idle = (jnp.uint32(now_s) - seen16) & np.uint32(0xFFFF)
         fresh = (idle <= lifetime) | (
-            idle > jnp.uint32(0xFFFF - CLOCK_SKEW_SLACK)
+            idle > np.uint32(0xFFFF - CLOCK_SKEW_SLACK)
         )
         return jnp.sum(live & fresh)

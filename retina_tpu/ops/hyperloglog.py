@@ -94,8 +94,8 @@ class HyperLogLog:
             folded = folded | (folded >> shift)
         hsb = jax.lax.population_count(folded).astype(jnp.int32) - 1  # -1 if rest==0
         rho = (nbits - hsb).astype(jnp.uint32)
-        rho = jnp.where(mask, rho, jnp.uint32(0))
-        flat_idx = group.astype(jnp.uint32) * jnp.uint32(m) + idx
+        rho = jnp.where(mask, rho, np.uint32(0))
+        flat_idx = group.astype(jnp.uint32) * np.uint32(m) + idx
         new_flat = (
             self.registers.reshape(-1)
             .at[flat_idx]

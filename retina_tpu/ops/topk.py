@@ -83,7 +83,7 @@ class TopKTable:
         # Winner rows: their estimate equals the slot's post-max count.
         # est>0 excludes padding rows (their estimate is forced to 0).
         win = (est == slot_now) & (est > 0)
-        safe_slot = jnp.where(win, slot, jnp.uint32(s))  # OOB rows dropped
+        safe_slot = jnp.where(win, slot, np.uint32(s))  # OOB rows dropped
         rows = jnp.stack(key_cols, axis=1).astype(jnp.uint32)  # (B, C)
         new_keys = self.key_rows.at[safe_slot].set(rows, mode="drop")
         # Winning lanes with equal estimates may race, but all carry valid

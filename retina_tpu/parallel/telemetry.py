@@ -258,7 +258,7 @@ class ShardedTelemetry:
     # ------------------------------------------------------------------
     def _build_snapshot_flat(self, state: PipelineState):
         base = self._build_snapshot()
-        shapes = jax.eval_shape(base, state, jnp.uint32(0))
+        shapes = jax.eval_shape(base, state, np.uint32(0))
         leaves, treedef = jax.tree_util.tree_flatten(shapes)
 
         def flat_fn(st, now_s):

@@ -146,7 +146,7 @@ def unpack_records_device(packed, base_lo, base_hi):
     surgery with the zero-extension to the step's static shape.
     """
     rel = packed[..., 0]
-    relm1 = rel - jnp.uint32(1)  # wraps for rel==0; masked below
+    relm1 = rel - np.uint32(1)  # wraps for rel==0; masked below
     ts_lo = base_lo + relm1
     carry = (ts_lo < relm1).astype(jnp.uint32)
     stamped = rel > 0
@@ -161,9 +161,9 @@ def unpack_records_device(packed, base_lo, base_hi):
     cols[F.BYTES] = packed[..., 5]
     cols[F.PACKETS] = packed[..., 6]
     cols[F.VERDICT] = misc >> 29
-    cols[F.DROP_REASON] = (misc >> 21) & jnp.uint32(0xFF)
-    cols[F.EVENT_TYPE] = (misc >> 17) & jnp.uint32(0xF)
-    cols[F.IFINDEX] = misc & jnp.uint32(0x1FFFF)
+    cols[F.DROP_REASON] = (misc >> 21) & np.uint32(0xFF)
+    cols[F.EVENT_TYPE] = (misc >> 17) & np.uint32(0xF)
+    cols[F.IFINDEX] = misc & np.uint32(0x1FFFF)
     cols[F.TSVAL] = packed[..., 8]
     cols[F.TSECR] = packed[..., 9]
     cols[F.DNS] = packed[..., 10]
