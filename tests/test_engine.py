@@ -488,3 +488,87 @@ def test_dead_dispatch_worker_drops_and_counts(monkeypatch):
     )._value.get()
     assert lost > 0
     assert lost + sink_lost >= fed * 0.5, (lost, sink_lost, fed)
+
+
+def test_table_update_enqueued_before_dispatch_is_visible():
+    """FIFO-visibility invariant for identity/filter tables: an update
+    whose proxied upload is ENQUEUED before a batch executes must be
+    applied to that batch — even when earlier proxy work delays the
+    queue by seconds. Regression for the r5 race where dispatch-build
+    captured the tables and a one-shot burst right after a pod
+    registration was silently dropped by the stale (empty) filter."""
+    from retina_tpu.utils import device_proxy
+    from retina_tpu.utils.device_proxy import submit_on_device
+
+    cfg = small_cfg(bypass_lookup_ip_of_interest=False)
+    eng = SketchEngine(cfg)
+    eng.update_identities({POD_NET + i: i for i in range(1, 20)})
+    eng.compile()
+    # Park the proxy: everything enqueued behind this sleeper waits,
+    # simulating a background-warm compile occupying the queue.
+    submit_on_device(time.sleep, 3.0)
+    # Deterministic ordering: spy on the proxy queue for the update's
+    # apply_filter closure landing in it, then dispatch — the batch is
+    # then PROVABLY enqueued after the table upload.
+    enqueued = threading.Event()
+    orig_put = device_proxy._q.put
+
+    def spy_put(item, *a, **kw):
+        fn = item[0]
+        if getattr(fn, "__qualname__", "").endswith("apply_filter"):
+            enqueued.set()
+        return orig_put(item, *a, **kw)
+
+    device_proxy._q.put = spy_put
+    try:
+        # Enqueue the filter update BEHIND the sleeper (blocks its
+        # caller until applied, so it runs on a side thread).
+        t = threading.Thread(
+            target=eng.update_filter_ips, args=({POD_NET + 7},),
+            daemon=True,
+        )
+        t.start()
+        assert enqueued.wait(2.5), "filter update never enqueued"
+    finally:
+        device_proxy._q.put = orig_put
+    # Dispatch a one-shot burst to the now-interesting pod. Enqueued
+    # after the filter upload -> must see it, not the empty pre-update
+    # map (which drops everything when bypass is off).
+    eng.step_records(mk_records(50, src_pods=np.full(50, 3),
+                                dst_pods=np.full(50, 7)))
+    t.join(10.0)
+    snap = eng.snapshot(max_age_s=0)
+    assert int(snap["totals"][0]) == 50, (
+        "batch dispatched after a filter update was filtered by the "
+        "stale map"
+    )
+
+
+def test_harvest_thread_retires_and_stays_retired():
+    """Engine shutdown retires the window-harvest thread; a straggler
+    close (e.g. a warm key racing stop) must not resurrect it — a
+    parked resurrected thread pins the engine object graph forever."""
+    cfg = small_cfg()
+    eng = SketchEngine(cfg)
+    eng.update_identities({POD_NET + i: i for i in range(1, 20)})
+    eng.compile()
+    stop = threading.Event()
+    t = threading.Thread(target=eng.start, args=(stop,), daemon=True)
+    t.start()
+    assert eng.started.wait(2.0)
+    eng.sink.write_records(mk_records(20, np.full(20, 2), np.full(20, 7)),
+                           "test")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and eng._events_in < 20:
+        time.sleep(0.05)
+    # A real close so the harvest thread exists before shutdown.
+    eng._close_window()
+    eng._harvest_window()
+    stop.set()
+    t.join(10.0)
+    assert eng._harvest_retired
+    old = eng._harvest_thread
+    assert old is None or not old.is_alive()
+    # Straggler after shutdown: must not spawn a fresh thread.
+    eng._ensure_harvest_thread()
+    assert eng._harvest_thread is old or not eng._harvest_thread.is_alive()
