@@ -20,6 +20,7 @@ import re
 import time
 import urllib.request
 
+import numpy as np
 import pytest
 
 from agentboot import running_agent
@@ -43,7 +44,7 @@ def _rss_mb() -> float:
     return int(m.group(1)) / 1024.0
 
 
-def test_soak_paced_rate_no_loss_no_leak():
+def _soak_cfg(**overrides) -> Config:
     cfg = Config()
     cfg.api_server_addr = "127.0.0.1:0"
     cfg.enabled_plugins = ["packetparser"]
@@ -62,52 +63,68 @@ def test_soak_paced_rate_no_loss_no_leak():
     cfg.window_seconds = 1.0
     cfg.metrics_interval_s = 0.5
     cfg.bypass_lookup_ip_of_interest = True
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
 
+
+def _scrape(port: int) -> tuple[float, str]:
+    t0 = time.perf_counter()
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ).read().decode()
+    return time.perf_counter() - t0, body
+
+
+def _warm_up(eng, port: int) -> None:
+    """Wait for first traffic + let compile/pregen/first windows settle
+    so measurements exclude one-time costs."""
+    t0 = time.monotonic()
+    while eng._events_in == 0:
+        assert time.monotonic() - t0 < 120, "no traffic within 120s"
+        time.sleep(0.2)
+    time.sleep(5.0)
+    _scrape(port)
+
+
+def _assert_no_loss(body: str) -> None:
+    lost = re.findall(
+        r'networkobservability_lost_events_counter_total{[^}]*} '
+        r'([0-9.e+]+)', body,
+    )
+    assert all(float(v) == 0.0 for v in lost), f"lost events: {lost}"
+
+
+def _assert_rate(rate: float, what: str) -> None:
+    # Paced emit: block emit cost adds to the inter-block wait, so the
+    # achieved rate sits just under nominal; far below means stalls.
+    assert 0.7 * RATE <= rate <= 1.05 * RATE, (
+        f"{what}: {rate:.0f} ev/s vs nominal {RATE}"
+    )
+
+
+def test_soak_paced_rate_no_loss_no_leak():
+    cfg = _soak_cfg()
     with running_agent(cfg, boot_timeout_s=60.0) as (d, port):
-
-        def scrape() -> tuple[float, str]:
-            t0 = time.perf_counter()
-            body = urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/metrics", timeout=10
-            ).read().decode()
-            return time.perf_counter() - t0, body
-
         eng = d.cm.engine
-        # Warm up: let compile + ring pregen + first windows settle so
-        # the RSS baseline excludes one-time allocations.
-        t0 = time.monotonic()
-        while eng._events_in == 0:
-            assert time.monotonic() - t0 < 120, "no traffic within 120s"
-            time.sleep(0.2)
-        time.sleep(5.0)
-        scrape()
+        _warm_up(eng, port)
 
         rss0 = _rss_mb()
         ev0 = eng._events_in
         start = time.monotonic()
         worst_scrape = 0.0
         while time.monotonic() - start < SOAK_SECONDS:
-            dt, body = scrape()
+            dt, body = _scrape(port)
             worst_scrape = max(worst_scrape, dt)
             assert "networkobservability_forward_count" in body
             time.sleep(max(0.0, 1.0 - dt))
         elapsed = time.monotonic() - start
         ev1 = eng._events_in
         rss1 = _rss_mb()
-        _, body = scrape()
+        _, body = _scrape(port)
 
-    rate = (ev1 - ev0) / elapsed
-    # Paced emit: block emit cost adds to the inter-block wait, so the
-    # achieved rate sits just under nominal; far below means stalls.
-    assert 0.7 * RATE <= rate <= 1.05 * RATE, (
-        f"paced rate off: {rate:.0f} ev/s vs nominal {RATE}"
-    )
-    # No loss at any stage, ever.
-    lost = re.findall(
-        r'networkobservability_lost_events_counter_total{[^}]*} '
-        r'([0-9.e+]+)', body,
-    )
-    assert all(float(v) == 0.0 for v in lost), f"lost events: {lost}"
+    _assert_rate((ev1 - ev0) / elapsed, "paced rate off")
+    _assert_no_loss(body)  # no loss at any stage, ever
     grew = rss1 - rss0
     assert grew < RSS_BUDGET_MB, (
         f"RSS grew {grew:.1f} MB over {elapsed:.0f}s (budget "
@@ -116,3 +133,62 @@ def test_soak_paced_rate_no_loss_no_leak():
     assert worst_scrape < SCRAPE_BUDGET_S, (
         f"worst scrape {worst_scrape * 1e3:.0f}ms over budget"
     )
+
+
+def test_soak_flow_dict_generation_cycling():
+    """Soak with the flow dictionary sized FAR below the live flow
+    count (1024 slots vs 5000 flows): the Zipf tail churns through the
+    table, cycling generations continuously. The contract under
+    cycling: the paced rate holds, zero lost events at every stage,
+    the generation counter actually climbs, and device totals stay
+    exact — generation clears are lossless (evicted descriptors
+    re-upload as new rows)."""
+    cfg = _soak_cfg(
+        flow_dict_slots=1 << 10,  # far below synthetic_flows
+        # The paced 50k ev/s feed produces flushes of a few thousand
+        # combined rows; the default transfer_min_bucket routes those
+        # to the plain path (the dictionary only pays off per row
+        # saved). Lower it so the soak's flushes actually exercise the
+        # dict wire.
+        transfer_min_bucket=256,
+    )
+    with running_agent(cfg, boot_timeout_s=60.0) as (d, port):
+        eng = d.cm.engine
+        _warm_up(eng, port)
+
+        gen0 = eng._flow_dict.generation
+        ev0 = eng._events_in
+        tot0 = int(np.asarray(eng.snapshot(max_age_s=0)["totals"])[0])
+        start = time.monotonic()
+        window = min(SOAK_SECONDS, 120.0)
+        while time.monotonic() - start < window:
+            dt, body = _scrape(port)
+            assert "networkobservability_forward_count" in body
+            time.sleep(max(0.0, 1.0 - dt))
+        elapsed = time.monotonic() - start
+        ev1 = eng._events_in
+        gen1 = eng._flow_dict.generation
+        # Quiesce: all in-flight dispatches land before the exactness
+        # read (snapshot serializes behind them on the proxy).
+        deadline = time.monotonic() + 10.0
+        tot1 = tot0
+        while time.monotonic() < deadline:
+            tot1 = int(np.asarray(eng.snapshot(max_age_s=0)["totals"])[0])
+            if tot1 - tot0 >= ev1 - ev0:
+                break
+            time.sleep(0.2)
+        _, body = _scrape(port)
+
+    _assert_rate((ev1 - ev0) / elapsed, "rate under generation cycling")
+    assert gen1 > gen0, (
+        f"generation never cycled ({gen0} -> {gen1}); the test is not "
+        "exercising eviction churn"
+    )
+    # Exactness under cycling: every ingested event is accounted in the
+    # device totals — a clear that silently dropped evicted descriptors
+    # would undercount here without touching lost_events.
+    assert tot1 - tot0 >= ev1 - ev0, (
+        f"device totals undercount ingested events under cycling: "
+        f"{tot1 - tot0} < {ev1 - ev0}"
+    )
+    _assert_no_loss(body)
