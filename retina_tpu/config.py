@@ -140,6 +140,26 @@ class Config:
     # dispatch round-trips). 0 = synchronous dispatch on the feed thread
     # (no overlap).
     feed_pipeline_depth: int = 3
+    # Sharded multi-worker host feed (parallel/feed.py): N feed workers
+    # each own a staging buffer, combine+partition their quantum in
+    # parallel (the native combiner releases the GIL), and hand
+    # finished batches to the single dispatch thread through a
+    # double-buffered transfer queue. 0 = auto (cores-1 capped at 4);
+    # values <= 1 keep the inline single-thread feed — a pool of one
+    # adds a handoff without adding a core. Requires
+    # feed_pipeline_depth > 0 (the sync path has no dispatch thread to
+    # hand off to).
+    feed_workers: int = 0
+    # Per-worker staging bound, in raw sink blocks. A block that finds
+    # every worker's staging full is dropped + counted (lost_events
+    # stage="handoff") — backpressure never blocks the distributor.
+    feed_staging_blocks: int = 256
+    # Background bucket-grid warm proxy duty cycle: after each warmed
+    # key the warm thread yields cost*(1-d)/d seconds (capped at 10s)
+    # to live traffic. 0.5 = equal yield (~50% proxy share, the
+    # historical behavior); raise toward 1.0 to finish the warm faster
+    # at the cost of feed throughput while it runs.
+    warm_duty_cycle: float = 0.5
     # Max windows of batch_capacity coalesced into ONE host->device
     # transfer when a flush quantum combines to more than one device
     # batch: the wire crosses the link once and is sliced into
@@ -194,6 +214,11 @@ class Config:
             raise ValueError(
                 f"dataAggregationLevel must be {AGG_LOW!r} or {AGG_HIGH!r}, "
                 f"got {self.data_aggregation_level!r}"
+            )
+        if not (0.0 < self.warm_duty_cycle <= 1.0):
+            raise ValueError(
+                f"warm_duty_cycle must be in (0, 1], "
+                f"got {self.warm_duty_cycle}"
             )
         for f in ("batch_capacity", "n_pods", "cms_width", "topk_slots",
                   "entropy_buckets", "conntrack_slots", "identity_slots"):

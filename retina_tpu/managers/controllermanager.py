@@ -99,6 +99,9 @@ class ControllerManager:
         self.server.expose_var(
             "heartbeat", lambda: self.telemetry.last_heartbeat
         )
+        # Sharded-feed backpressure: per-worker fill / staged backlog /
+        # handoff wait + drop counters (engine.feed_stats).
+        self.server.expose_var("feed", self.engine.feed_stats)
         self.server.expose_var("top_flows", self._top_flows)
         self.server.expose_var("top_services", self._top_services)
         self.server.expose_var("top_dns", self._top_dns)

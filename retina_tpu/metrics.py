@@ -91,6 +91,15 @@ class Metrics:
         )
         self.device_batch_fill = g(mn.DEVICE_BATCH_FILL, [])
         self.windows_closed = c(mn.WINDOWS_CLOSED, [])
+        # Window ticks deferred while the close program was still
+        # queued in the background warm (stall-free close contract).
+        self.windows_deferred = c(mn.WINDOWS_DEFERRED, [])
+        # Sharded feed-worker backpressure (parallel/feed.py).
+        self.feed_worker_fill = g(mn.FEED_WORKER_FILL, [mn.L_WORKER])
+        self.feed_handoff_wait = c(mn.FEED_HANDOFF_WAIT, [mn.L_WORKER])
+        self.feed_blocks_dropped = c(
+            mn.FEED_BLOCKS_DROPPED, [mn.L_WORKER]
+        )
         # events-in / rows-transferred of the host combiner (the kernel-map
         # aggregation factor; parallel/combine.py). 1.0 = nothing merged.
         self.combine_ratio = g(mn.COMBINE_RATIO, [])

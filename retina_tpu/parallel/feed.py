@@ -1,0 +1,355 @@
+"""Sharded multi-worker host feed: staging, combine/partition workers,
+and the double-buffered transfer handoff to the dispatch thread.
+
+The reference agent parallelizes its ingest the same way the kernel
+does — per-CPU perf rings drained by independent readers
+(packetparser_linux.go:556-652). Here the engine's feed loop (the
+*distributor*) drains the plugin sink and deals raw record blocks
+round-robin across N :class:`FeedWorker` threads. Each worker owns a
+private staging deque, accumulates a flush quantum, and runs the
+CPU-heavy half of a flush — combine + partition — off the distributor
+thread (the native combiner releases the GIL, so workers overlap on
+real cores). Finished :class:`~retina_tpu.parallel.partition.ShardedBatch`
+items hand off to the single dispatch thread through a
+:class:`TransferQueue`: a depth-2 (double-buffered) SPSC deque — one
+batch in flight on the dispatch side while the next is fully built —
+with no lock on the hot path (CPython deque append/popleft are atomic;
+events only park a side that has nothing to do).
+
+What does NOT move off the dispatch thread: flow-dict assignment, wire
+build, and the proxy submission. The v3 wire ordering contract (a new
+descriptor row must reach the device table before any known row
+references its slot — engine._dispatch_flowdict) requires ONE
+serialization point, and the dispatch thread is it.
+
+Backpressure contract (same as everywhere else in the tree): never
+block a producer. A block that finds every worker's staging full is
+dropped and counted (per-worker drop counters + the lost_events
+``handoff`` stage); a worker whose handoff queue stays full because the
+dispatch thread died drops the finished batch through the pool's
+``drop`` callback, which counts it exactly like the inline feed's
+dead-worker path.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from retina_tpu.log import logger
+
+_log = logger("feed")
+
+# Handoff queue depth: double buffering. One batch being consumed, one
+# built and waiting. Deeper queues only add host memory and latency —
+# the engine's _inflight semaphore already bounds device-side overlap.
+TRANSFER_DEPTH = 2
+
+
+class TransferQueue:
+    """Bounded SPSC handoff (producer: one feed worker; consumer: the
+    dispatch thread via :class:`TransferMux`). append/popleft are the
+    only hot-path operations; the events are parking lots, not locks."""
+
+    __slots__ = ("q", "depth", "space", "data", "wait_s")
+
+    def __init__(self, depth: int, data: threading.Event):
+        self.q: deque = deque()
+        self.depth = depth
+        self.space = threading.Event()
+        self.data = data  # shared with the mux: any producer wakes it
+        self.wait_s = 0.0  # producer-side seconds spent waiting for space
+
+    def put(self, item: Any, alive: Optional[Callable[[], bool]] = None,
+            ) -> bool:
+        """Enqueue, waiting for a free slot. Returns False (item NOT
+        enqueued) once ``alive`` goes falsy — the consumer died and the
+        caller must drop + count instead of wedging forever."""
+        t0 = None
+        while len(self.q) >= self.depth:
+            if alive is not None and not alive():
+                if t0 is not None:
+                    self.wait_s += time.monotonic() - t0
+                return False
+            if t0 is None:
+                t0 = time.monotonic()
+            # Timeout bounds the one benign race (consumer sets space
+            # between our len check and wait).
+            self.space.wait(0.02)
+            self.space.clear()
+        if t0 is not None:
+            self.wait_s += time.monotonic() - t0
+        self.q.append(item)
+        self.data.set()
+        return True
+
+
+class TransferMux:
+    """Single-consumer fan-in over every worker's TransferQueue plus a
+    control lane (window ticks, shutdown sentinel). Drop-in for the
+    inline feed's queue.Queue in engine._dispatch_loop: ``get()``
+    blocks and returns items; ``None`` means shut down.
+
+    The control lane has priority — window closes stay on cadence even
+    under a step backlog. A close overtaking batches still staged in
+    the workers just shifts those events into the next window, exactly
+    as if they were still in the sink. The shutdown sentinel is the one
+    exception: it is delivered only after EVERY worker queue has
+    drained (workers are joined before the sentinel is enqueued, so
+    their queues are strictly draining by then)."""
+
+    def __init__(self, queues: list[TransferQueue], data: threading.Event):
+        self._qs = queues
+        self._ctl: deque = deque()
+        self._data = data
+        self._rr = 0
+
+    def put_ctl(self, item: Any) -> None:
+        self._ctl.append(item)
+        self._data.set()
+
+    def get(self, timeout: float | None = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._ctl and self._ctl[0] is not None:
+                return self._ctl.popleft()
+            draining = bool(self._ctl)  # head is the None sentinel
+            n = len(self._qs)
+            for k in range(n):
+                tq = self._qs[(self._rr + k) % n]
+                try:
+                    item = tq.q.popleft()
+                except IndexError:
+                    continue
+                tq.space.set()
+                self._rr = (self._rr + k + 1) % n
+                return item
+            if draining:
+                return self._ctl.popleft()
+            if deadline is not None and time.monotonic() >= deadline:
+                raise queue_mod.Empty
+            self._data.wait(0.002)
+            self._data.clear()
+
+
+class FeedWorker(threading.Thread):
+    """One ingest shard: staging deque -> quantum flush -> handoff.
+
+    Counter discipline (lock-free accounting): ``*_in`` fields are
+    written only by the distributor, ``*_out`` only by this worker —
+    both monotonic, so ``pending = in - out`` is always consistent
+    without a lock (a torn read can only be momentarily stale)."""
+
+    def __init__(self, idx: int, pool: "FeedWorkerPool",
+                 data: threading.Event):
+        super().__init__(name=f"feed-worker-{idx}", daemon=True)
+        self.idx = idx
+        self.pool = pool
+        self.staging: deque = deque()
+        self.outq = TransferQueue(pool.depth, data)
+        self.wake = threading.Event()
+        self.events_in = 0       # distributor-only
+        self.blocks_in = 0       # distributor-only
+        self.events_out = 0      # worker-only
+        self.blocks_out = 0      # worker-only
+        self.first_t = 0.0       # stamp of the oldest staged block
+        self.fill = 0.0          # last flush's quantum fill ratio
+        self.batches = 0
+        self.handoff_dropped = 0  # worker-only: items the consumer lost
+
+    # -- distributor side --------------------------------------------
+    def pending_blocks(self) -> int:
+        return self.blocks_in - self.blocks_out
+
+    def pending_events(self) -> int:
+        return self.events_in - self.events_out
+
+    def push(self, block) -> None:
+        if self.pending_events() == 0:
+            self.first_t = time.monotonic()
+        self.staging.append(block)
+        self.blocks_in += 1
+        self.events_in += len(block)
+        self.wake.set()
+
+    # -- worker side --------------------------------------------------
+    def run(self) -> None:
+        try:
+            while True:
+                stopping = self.pool.stop_evt.is_set()
+                pend = self.pending_events()
+                if pend == 0:
+                    if stopping:
+                        return
+                    self.wake.wait(0.002)
+                    self.wake.clear()
+                    continue
+                age = time.monotonic() - self.first_t
+                # Same flush policy as the inline feed: full quantum,
+                # or the hard age bound, or an interval flush when the
+                # dispatch pipeline is idle (latency priority only when
+                # nothing is in flight).
+                if not (
+                    pend >= self.pool.quantum
+                    or stopping
+                    or age >= self.pool.flush_max_age_s
+                    or (age >= self.pool.flush_interval_s
+                        and self.pool.busy() == 0)
+                ):
+                    self.wake.wait(0.002)
+                    self.wake.clear()
+                    continue
+                self._flush()
+        except Exception:
+            _log.exception("feed worker %d died", self.idx)
+
+    def _flush(self) -> None:
+        blocks = []
+        n_raw = 0
+        while n_raw < self.pool.quantum:
+            try:
+                b = self.staging.popleft()
+            except IndexError:
+                break
+            blocks.append(b)
+            n_raw += len(b)
+        if not blocks:
+            return
+        # Release staging capacity BEFORE the (long) combine: the
+        # backpressure signal tracks what is staged, not what is being
+        # crunched.
+        self.blocks_out += len(blocks)
+        self.events_out += n_raw
+        self.first_t = time.monotonic()
+        self.fill = n_raw / max(self.pool.quantum, 1)
+        items = self.pool.build_steps(blocks, n_raw, int(time.time()))
+        for it in items:
+            if not self.outq.put(it, alive=self.pool.alive):
+                self.handoff_dropped += 1
+                self.pool.drop(it)
+        self.batches += 1
+        self._publish_metrics()
+
+    def _publish_metrics(self) -> None:
+        from retina_tpu.metrics import get_metrics
+
+        m = get_metrics()
+        w = str(self.idx)
+        m.feed_worker_fill.labels(worker=w).set(self.fill)
+        # Counters are cumulative; publish the delta since last flush
+        # by tracking the high-water mark locally.
+        m.feed_handoff_wait.labels(worker=w).inc(
+            max(0.0, self.outq.wait_s - getattr(self, "_wait_pub", 0.0))
+        )
+        self._wait_pub = self.outq.wait_s
+
+    def stat(self) -> dict[str, Any]:
+        return {
+            "worker": self.idx,
+            "fill": round(self.fill, 3),
+            "staged_blocks": self.pending_blocks(),
+            "staged_events": self.pending_events(),
+            "handoff_wait_s": round(self.outq.wait_s, 3),
+            "batches": self.batches,
+            "events": self.events_out,
+            "handoff_dropped": self.handoff_dropped,
+        }
+
+
+class FeedWorkerPool:
+    """N feed workers + the mux the dispatch thread consumes.
+
+    ``build_steps(blocks, n_raw, now_s) -> list[item]`` is the engine's
+    combine+partition stage (pure host work, safe concurrently);
+    ``drop(item)`` is called for any finished item the dispatch side
+    will never consume (dead consumer) so losses are counted, never
+    silent; ``busy()`` returns the in-flight dispatch count (interval
+    flush gating); ``alive()`` reports dispatch-thread liveness."""
+
+    def __init__(
+        self,
+        n_workers: int,
+        quantum: int,
+        staging_blocks: int,
+        flush_interval_s: float,
+        flush_max_age_s: float,
+        build_steps: Callable[[list, int, int], list],
+        drop: Callable[[Any], None],
+        busy: Callable[[], int] = lambda: 0,
+        alive: Callable[[], bool] = lambda: True,
+        depth: int = TRANSFER_DEPTH,
+    ):
+        self.quantum = max(1, int(quantum))
+        self.staging_blocks = max(1, int(staging_blocks))
+        self.flush_interval_s = flush_interval_s
+        self.flush_max_age_s = flush_max_age_s
+        self.build_steps = build_steps
+        self.drop = drop
+        self.busy = busy
+        self.alive = alive
+        self.depth = max(1, int(depth))
+        self.stop_evt = threading.Event()
+        data = threading.Event()
+        self.workers = [
+            FeedWorker(i, self, data) for i in range(max(1, n_workers))
+        ]
+        self.mux = TransferMux([w.outq for w in self.workers], data)
+        self._rr = 0
+        # Distributor-only counters: blocks no worker could take.
+        self.staging_dropped_blocks = 0
+        self.staging_dropped_events = 0
+
+    def start(self) -> None:
+        for w in self.workers:
+            w.start()
+
+    def stage(self, block) -> bool:
+        """Deal one raw block to a worker (round-robin, skipping full
+        or dead shards). Returns False — caller drops + counts — only
+        when EVERY worker is saturated or gone."""
+        n = len(self.workers)
+        for k in range(n):
+            w = self.workers[(self._rr + k) % n]
+            if w.is_alive() and w.pending_blocks() < self.staging_blocks:
+                self._rr = (self._rr + k + 1) % n
+                w.push(block)
+                return True
+        return False
+
+    def count_drop(self, n_events: int) -> None:
+        """Distributor-side drop accounting for a block no worker could
+        take (the caller also counts it into lost_events)."""
+        from retina_tpu.metrics import get_metrics
+
+        self.staging_dropped_blocks += 1
+        self.staging_dropped_events += n_events
+        get_metrics().feed_blocks_dropped.labels(
+            worker=str(self._rr % len(self.workers))
+        ).inc()
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Signal stop and join the workers; each final-flushes its
+        staged quantum first (handoffs still drain: the dispatch thread
+        keeps consuming until it sees the mux sentinel, which the
+        engine enqueues only after this returns)."""
+        self.stop_evt.set()
+        deadline = time.monotonic() + timeout
+        for w in self.workers:
+            w.wake.set()
+        for w in self.workers:
+            w.join(max(0.0, deadline - time.monotonic()))
+            if w.is_alive():
+                _log.error("feed worker %d did not stop in time", w.idx)
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "workers": len(self.workers),
+            "mode": "sharded",
+            "quantum": self.quantum,
+            "dropped_blocks": self.staging_dropped_blocks,
+            "dropped_events": self.staging_dropped_events,
+            "per_worker": [w.stat() for w in self.workers],
+        }

@@ -99,6 +99,20 @@ class HostFlowDict:
         return ids, is_new
 
 
+def flow_dict_stats(fd) -> dict:
+    """Residency summary for debug vars / bench JSON. Duck-typed over
+    both implementations (capacity / __len__ / generation); ``fd`` may
+    be None when packed wire or the flow dict is disabled."""
+    if fd is None:
+        return {"enabled": False}
+    return {
+        "enabled": True,
+        "entries": len(fd),
+        "capacity": int(fd.capacity),
+        "generation": int(fd.generation),
+    }
+
+
 def make_flow_dict(capacity: int):
     """Native (GIL-released single pass, native/flowdict.cpp) when the
     library is available, else the Python dict. Same contract either

@@ -32,6 +32,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from retina_tpu.models.identity import IdentityMap
 from retina_tpu.models.pipeline import PipelineConfig, PipelineState, TelemetryPipeline
 
+# jax >= 0.5 promotes shard_map to the top-level namespace and renames
+# the replication checker kwarg check_rep -> check_vma; 0.4.x keeps both
+# the experimental home and the old name. Resolve once so every _build_*
+# site stays version agnostic.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _exp_shard_map(f, **kw)
+
 
 class ShardedTelemetry:
     """TelemetryPipeline spread over a jax.sharding.Mesh.
@@ -97,7 +111,7 @@ class ShardedTelemetry:
             return new, out
 
         sh = self._sharded_spec
-        fn = jax.shard_map(
+        fn = _shard_map(
             local_step,
             mesh=self.mesh,
             in_specs=(sh, sh, sh, P(), P(), P(), P(), P()),
@@ -176,7 +190,7 @@ class ShardedTelemetry:
             return new, {"entropy_bits": h, "anomaly": flags, "zscore": z}
 
         sh = self._sharded_spec
-        fn = jax.shard_map(
+        fn = _shard_map(
             local_end,
             mesh=self.mesh,
             in_specs=(sh, P()),
@@ -238,7 +252,7 @@ class ShardedTelemetry:
                 "active_conns": psum(s.conntrack.active_connections(now_s)),
             }
 
-        fn = jax.shard_map(
+        fn = _shard_map(
             local_snap,
             mesh=self.mesh,
             in_specs=(self._sharded_spec, P()),

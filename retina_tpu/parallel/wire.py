@@ -76,6 +76,20 @@ def ts_rel(records: np.ndarray, base: np.uint64) -> np.ndarray:
     ).astype(np.uint32)
 
 
+def known_rows(
+    rows: np.ndarray, ids: np.ndarray, id_bits: int, out: np.ndarray
+) -> None:
+    """Fill the 2-word known-row wire encoding in place:
+    ``word0 = flow_id | packets << id_bits``, ``word1 = bytes``.
+
+    One definition shared by the engine's numpy fallback
+    (engine._dispatch_flowdict) and bench's host-path probe — the
+    encoding IS the v3 wire contract, and two hand-rolled copies of the
+    bit layout can silently drift apart."""
+    out[:, 0] = ids | (rows[:, F.PACKETS] << id_bits)
+    out[:, 1] = rows[:, F.BYTES]
+
+
 def pack_records(
     records: np.ndarray, base: np.uint64 | None = None
 ) -> tuple[np.ndarray, np.uint32, np.uint32]:

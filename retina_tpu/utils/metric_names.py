@@ -78,6 +78,19 @@ FLOW_DICT_GENERATION = PREFIX + "tpu_flow_dict_generation"
 WIRE_ROWS = PREFIX + "tpu_wire_rows_counter"
 L_KIND = "kind"
 PARSED_PACKETS = PREFIX + "parsed_packets_counter"
+# Sharded feed-worker backpressure (parallel/feed.py): per-worker
+# quantum fill at flush, seconds spent waiting for a free handoff slot
+# (a persistently growing wait means the dispatch/device side is the
+# bottleneck, not the host), and blocks dropped because every worker's
+# staging was full.
+FEED_WORKER_FILL = PREFIX + "tpu_feed_worker_fill_ratio"
+FEED_HANDOFF_WAIT = PREFIX + "tpu_feed_handoff_wait_seconds"
+FEED_BLOCKS_DROPPED = PREFIX + "tpu_feed_blocks_dropped"
+L_WORKER = "worker"
+# Window ticks deferred because the close program was still queued in
+# the background warm (engine._close_window_impl): the window stays
+# open instead of cold-compiling end_window inline mid-feed.
+WINDOWS_DEFERRED = PREFIX + "tpu_windows_deferred"
 DEVICE_STEP_SECONDS = PREFIX + "tpu_step_seconds"
 DEVICE_BATCH_FILL = PREFIX + "tpu_batch_fill_ratio"
 WINDOWS_CLOSED = PREFIX + "tpu_windows_closed"

@@ -91,6 +91,56 @@ def test_background_warm_plain_mode_covers_coalesced_buckets():
         assert (b, packed) in eng._pad_cache, b
 
 
+def test_warm_close_is_first_background_job():
+    """The window-close program heads the background-warm job list —
+    ahead of even the min-bucket dispatch pair. The first live window
+    tick fires window_seconds after boot, almost always before any
+    grid key compiles; with warm_close queued first the tick finds the
+    program resident (or deferring, below) instead of cold-compiling
+    end_window inline on the proxy mid-feed."""
+    eng = SketchEngine(small_cfg(feed_coalesce_windows=2))
+    jobs = eng._warm_jobs()
+    assert jobs[0][0] == "window close", [k for k, _, _ in jobs[:3]]
+    # And the plain-wire grid keeps the same head.
+    cfg = small_cfg(feed_coalesce_windows=2)
+    cfg.wire_flow_dict = False
+    assert SketchEngine(cfg)._warm_jobs()[0][0] == "window close"
+
+
+def test_pre_warm_window_tick_defers_instead_of_inline_compile():
+    """A window tick arriving while the close program is still queued in
+    the background warm DEFERS (windows_deferred) instead of compiling
+    end_window inline; once the program is resident the next tick
+    closes normally."""
+    from retina_tpu.events.synthetic import TrafficGen
+    from retina_tpu.metrics import get_metrics
+
+    eng = SketchEngine(small_cfg(feed_coalesce_windows=2))
+    eng.compile()
+    gen = TrafficGen(n_flows=100, n_pods=16, seed=11)
+    eng.step_records(gen.batch(256), now_s=10)
+
+    class _StuckWarm:
+        """A warm thread that never finishes (compiles wedged)."""
+
+        def is_alive(self) -> bool:
+            return True
+
+    eng._warm_thread = _StuckWarm()
+    m = get_metrics()
+    closed0 = m.windows_closed._value.get()
+    eng._close_window()
+    assert m.windows_deferred._value.get() == 1
+    assert m.windows_closed._value.get() == closed0
+    # Close program lands (warm's first job sets the event) -> the next
+    # tick must close the (longer) window with every event intact.
+    eng._close_warmed.set()
+    eng._close_window()
+    eng._harvest_window()
+    assert m.windows_deferred._value.get() == 1
+    assert m.windows_closed._value.get() == closed0 + 1
+
+
 def test_background_warm_stops_early_on_shutdown():
     eng = SketchEngine(small_cfg(feed_coalesce_windows=2))
     eng.compile()

@@ -14,6 +14,11 @@ Checks (all precise, no style opinions):
   F601  duplicate dict literal key
   B011  assert on a non-empty tuple (always true)
   F811  duplicate top-level def/class name
+  RT100 threading.Thread spawned in engine.py outside the sanctioned
+        helpers (start, start_background_warm, _ensure_harvest_thread).
+        Every engine thread must be created where shutdown joins it —
+        a thread spawned ad hoc escapes the stop/join protocol and the
+        device-proxy single-thread invariant review.
 
 `# noqa` (with or without a code) on the flagged line suppresses it.
 Exit code 1 if any finding. Usage: python tools/lint.py [paths...]
@@ -144,6 +149,36 @@ def check_file(path: Path) -> list[tuple[int, str, str]]:
             if isinstance(node.test, ast.Tuple) and node.test.elts:
                 add(node.lineno, "B011",
                     "assert on a tuple is always true")
+
+    # RT100 — engine thread spawns outside the sanctioned helpers.
+    # The engine's threads all follow a create-here/join-at-shutdown
+    # protocol (feed loop finally block); a Thread() anywhere else in
+    # the file is a leak of that protocol until proven otherwise.
+    if path.name == "engine.py":
+        sanctioned = {
+            "start", "start_background_warm", "_ensure_harvest_thread",
+        }
+
+        def _walk_fn(node: ast.AST, fn: str | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                nxt = fn
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    # Nested defs (closures like _warm) belong to the
+                    # sanctioned outer helper that defines them.
+                    nxt = fn if fn in sanctioned else child.name
+                if (isinstance(child, ast.Call)
+                        and isinstance(child.func, ast.Attribute)
+                        and child.func.attr == "Thread"
+                        and isinstance(child.func.value, ast.Name)
+                        and child.func.value.id == "threading"
+                        and fn not in sanctioned):
+                    add(child.lineno, "RT100",
+                        "threading.Thread spawned outside sanctioned "
+                        f"engine helpers (in `{fn or '<module>'}`)")
+                _walk_fn(child, nxt)
+
+        _walk_fn(tree, None)
     return finds
 
 
