@@ -1,7 +1,7 @@
 # Developer entry points. CI runs the same commands
 # (.github/workflows/); the driver runs bench.py directly.
 
-.PHONY: test native bench bench-smoke soak distributed lint clean
+.PHONY: test native bench bench-smoke soak distributed chaos lint clean
 
 native:
 	$(MAKE) -C retina_tpu/native
@@ -20,6 +20,11 @@ bench-smoke: native
 soak: native
 	RETINA_SOAK=1 RETINA_SOAK_SECONDS=300 \
 	    python -m pytest tests/test_soak.py -q
+
+# Fault-injection suite: every injected fault (transfer error, hung
+# harvest, plugin crash, corrupt checkpoint) must recover in-process.
+chaos: native
+	python -m pytest tests/ -q -m chaos
 
 # Two-process jax.distributed mesh test (spawns 2 JAX procs).
 distributed:

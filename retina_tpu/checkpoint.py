@@ -34,12 +34,17 @@ def _fingerprint(pcfg: PipelineConfig) -> str:
 
 
 def save_state(path: str, state, pcfg: PipelineConfig) -> None:
+    """Atomic checkpoint write: full npz to a same-directory temp file,
+    fsync, then rename over ``path`` — a crash mid-write leaves the old
+    checkpoint intact, never a torn one."""
+    from retina_tpu.runtime import faults
+
     leaves = jax.tree.flatten(state)[0]
     host = [np.asarray(x) for x in leaves]
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = path + ".tmp"
+    tmp = f"{path}.{os.getpid()}.tmp"
     np.savez_compressed(
-        tmp if tmp.endswith(".npz") else tmp,
+        tmp,
         __config__=np.frombuffer(
             _fingerprint(pcfg).encode(), np.uint8
         ),
@@ -47,30 +52,68 @@ def save_state(path: str, state, pcfg: PipelineConfig) -> None:
     )
     # np.savez appends .npz when missing; normalize then atomically swap.
     actual_tmp = tmp if tmp.endswith(".npz") else tmp + ".npz"
+    if faults.should_corrupt("checkpoint"):
+        # Chaos hook: simulate the torn write the tmp+rename protocol
+        # exists to prevent, so load_state's corruption path is
+        # exercised end to end.
+        size = os.path.getsize(actual_tmp)
+        with open(actual_tmp, "r+b") as fh:
+            fh.truncate(max(16, size // 2))
+    with open(actual_tmp, "rb") as fh:
+        os.fsync(fh.fileno())
     os.replace(actual_tmp, path)
     _log.info("state checkpoint written: %s (%d leaves)", path, len(host))
 
 
+def _quarantine(path: str, why: str) -> None:
+    _log.warning(
+        "checkpoint unusable (%s): %s — quarantining to %s.bad and "
+        "cold-starting", why, path, path,
+    )
+    try:
+        os.replace(path, path + ".bad")
+    except OSError:
+        _log.warning("could not quarantine %s", path, exc_info=True)
+
+
 def load_state(path: str, sharded, pcfg: PipelineConfig):
-    """Restore into a zero state built by ``sharded.init_state()``."""
-    with np.load(path) as z:
-        stored_cfg = bytes(z["__config__"]).decode()
-        if stored_cfg != _fingerprint(pcfg):
-            raise ValueError(
-                "checkpoint config mismatch; refusing to load "
-                "(table shapes changed — start fresh)"
-            )
-        zero = sharded.init_state()
-        leaves, treedef = jax.tree.flatten(zero)
-        loaded = []
-        for i, leaf in enumerate(leaves):
-            a = z[f"leaf_{i}"]
-            if a.shape != leaf.shape or a.dtype != leaf.dtype:
-                raise ValueError(
-                    f"checkpoint leaf {i} shape/dtype mismatch: "
-                    f"{a.shape}/{a.dtype} vs {leaf.shape}/{leaf.dtype}"
+    """Restore into a zero state built by ``sharded.init_state()``.
+
+    Crash-only contract: a missing, truncated, corrupt, or
+    fingerprint-mismatched checkpoint never raises — the bad file is
+    quarantined to ``path + ".bad"`` and a clean zero state is
+    returned. Returns ``(state, resumed)`` where ``resumed`` is False
+    on any cold start.
+    """
+    zero = sharded.init_state()
+    if not os.path.exists(path):
+        return zero, False
+    try:
+        with np.load(path) as z:
+            stored_cfg = bytes(z["__config__"]).decode()
+            if stored_cfg != _fingerprint(pcfg):
+                _quarantine(
+                    path, "config fingerprint mismatch — table shapes changed"
                 )
-            loaded.append(a)
+                return zero, False
+            leaves, treedef = jax.tree.flatten(zero)
+            loaded = []
+            for i, leaf in enumerate(leaves):
+                a = z[f"leaf_{i}"]
+                if a.shape != leaf.shape or a.dtype != leaf.dtype:
+                    _quarantine(
+                        path,
+                        f"leaf {i} shape/dtype mismatch "
+                        f"({a.shape}/{a.dtype} vs {leaf.shape}/{leaf.dtype})",
+                    )
+                    return zero, False
+                loaded.append(a)
+    except Exception as e:
+        # zipfile/np.load raise a zoo of types on truncated or garbage
+        # files (BadZipFile, EOFError, KeyError, OSError, ValueError);
+        # all of them mean the same thing here: not a usable checkpoint.
+        _quarantine(path, f"{type(e).__name__}: {e}")
+        return zero, False
     state = jax.tree.unflatten(treedef, loaded)
     _log.info("state checkpoint restored: %s", path)
-    return state
+    return state, True

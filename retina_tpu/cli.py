@@ -484,7 +484,7 @@ def cmd_observe(args: argparse.Namespace) -> int:
                     f"{l4['destination_port']} {l4['protocol']} "
                     f"{flow['verdict']} {flow['event_type']}"
                 )
-    except KeyboardInterrupt:
+    except KeyboardInterrupt:  # noqa: RT101 — ctrl-C ends the tail cleanly
         pass
     finally:
         client.close()

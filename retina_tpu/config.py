@@ -194,6 +194,34 @@ class Config:
     snapshot_dir: str = ""  # sketch-state checkpoint dir ("" = off)
     snapshot_interval_s: float = 0.0  # 0 = only on shutdown
 
+    # --- supervised runtime (runtime/supervisor.py) ---
+    # A registered thread that neither beats nor parks for this long is
+    # a stall: counted in watchdog_stalls and escalated (hung harvest
+    # threads get replaced). Also the default bound on blocking fences
+    # in the crash-only recovery path.
+    watchdog_deadline_s: float = 30.0
+    # Watchdog scan cadence.
+    watchdog_interval_s: float = 0.5
+    # Shutdown drain bound for the final harvest queue flush (was a
+    # hard-coded 30.0 in engine._harvest_window).
+    harvest_timeout_s: float = 30.0
+    # Restart policy: exponential backoff base/cap with multiplicative
+    # jitter; after restart_max_failures consecutive crashes inside
+    # restart_window_s the circuit OPENS (the plugin/thread stops being
+    # restarted and /healthz goes unhealthy) and half-open probes run
+    # every circuit_half_open_s until one stays healthy.
+    restart_backoff_base_s: float = 0.2
+    restart_backoff_max_s: float = 30.0
+    restart_backoff_jitter: float = 0.2
+    restart_max_failures: int = 5
+    restart_window_s: float = 60.0
+    circuit_half_open_s: float = 30.0
+    # Deterministic fault injection (runtime/faults.py), e.g.
+    # "transfer:raise@3,plugin.packetparser:raise@1". Empty = disarmed.
+    # Settable via RETINA_FAULT_SPEC for chaos drills against a
+    # deployed agent.
+    fault_spec: str = ""
+
     # --- pipeline shapes (jit keys; see models/pipeline.py) ---
     n_pods: int = 1 << 12
     cms_width: int = 1 << 15
@@ -220,6 +248,35 @@ class Config:
                 f"warm_duty_cycle must be in (0, 1], "
                 f"got {self.warm_duty_cycle}"
             )
+        for f in ("watchdog_deadline_s", "watchdog_interval_s",
+                  "harvest_timeout_s", "restart_backoff_base_s",
+                  "restart_backoff_max_s", "restart_window_s",
+                  "circuit_half_open_s"):
+            if getattr(self, f) <= 0:
+                raise ValueError(f"{f} must be > 0, got {getattr(self, f)}")
+        if self.restart_max_failures < 1:
+            raise ValueError(
+                f"restart_max_failures must be >= 1, "
+                f"got {self.restart_max_failures}"
+            )
+        if self.restart_backoff_jitter < 0:
+            raise ValueError(
+                f"restart_backoff_jitter must be >= 0, "
+                f"got {self.restart_backoff_jitter}"
+            )
+        if self.fault_spec:
+            # Fail at config load, not mid-flight in a hot-path hook:
+            # faults.configure re-parses the same grammar when the
+            # daemon arms it, so a parse-only dry run here is cheap.
+            import re as _re
+
+            for raw in self.fault_spec.split(","):
+                raw = raw.strip()
+                if raw and not _re.match(
+                    r"^[\w.\-]+:(raise|corrupt|hang(\d+(\.\d+)?)?)(@\d+)?$",
+                    raw,
+                ):
+                    raise ValueError(f"bad fault_spec entry {raw!r}")
         for f in ("batch_capacity", "n_pods", "cms_width", "topk_slots",
                   "entropy_buckets", "conntrack_slots", "identity_slots"):
             v = getattr(self, f)

@@ -32,6 +32,13 @@ class Daemon:
         if enable_compilation_cache(cfg.compilation_cache_dir):
             self.log.info("XLA compilation cache at %s",
                           cfg.compilation_cache_dir)
+        if cfg.fault_spec:
+            # Deterministic fault injection (chaos testing): armed only
+            # when explicitly configured (RETINA_FAULT_SPEC / config).
+            from retina_tpu.runtime import faults
+
+            faults.configure(cfg.fault_spec)
+            self.log.warning("fault injection armed: %s", cfg.fault_spec)
         self.cm = ControllerManager(cfg, apiserver_host=apiserver_host)
         # Identity from a real cluster (pkg/k8s watcher analog): core/v1
         # pods/services/nodes land in the same cache the CRD-store path
@@ -245,19 +252,16 @@ class Daemon:
 
             path = os.path.join(self.cfg.snapshot_dir, "sketch_state.npz")
             if os.path.exists(path):
-                try:
-                    self.cm.engine.load_snapshot_state(path)
+                # Crash-only contract: load_state never raises — an
+                # unreadable checkpoint (stale fingerprint, corrupt or
+                # truncated npz) is quarantined to .bad inside
+                # checkpoint.load_state and we cold-start.
+                if self.cm.engine.load_snapshot_state(path):
                     self.log.info("resumed sketch state from %s", path)
-                except Exception as e:
-                    # Any unreadable checkpoint (stale fingerprint, corrupt
-                    # or truncated npz) must not crash-loop the agent: move
-                    # it aside and start fresh.
-                    self.log.warning("checkpoint ignored (%s): %s",
-                                     type(e).__name__, e)
-                    try:
-                        os.replace(path, path + ".bad")
-                    except OSError:
-                        pass
+                else:
+                    self.log.warning(
+                        "checkpoint at %s unusable; cold-starting", path
+                    )
         if self.kubewatch is not None:
             self.kubewatch.start()
         if self.ciliumwatch is not None:

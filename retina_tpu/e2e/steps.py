@@ -73,7 +73,7 @@ class BootAgent(Step):
                 try:
                     ctx["port"] = d.cm.server.port
                     return
-                except AssertionError:
+                except AssertionError:  # noqa: RT101 — server port not bound yet; poll loop
                     pass
             if not t.is_alive():
                 raise StepFailed("agent thread died during boot")
@@ -101,7 +101,7 @@ class WaitReady(Step):
             try:
                 if urllib.request.urlopen(url, timeout=2).status == 200:
                     return
-            except Exception:
+            except Exception:  # noqa: RT101 — readiness poll; failure = retry
                 pass
             time.sleep(0.1)
         raise StepFailed("readyz never turned 200")

@@ -33,7 +33,7 @@ import numpy as np
 
 from retina_tpu.config import Config
 from retina_tpu.events.schema import F, NUM_FIELDS
-from retina_tpu.log import logger
+from retina_tpu.log import logger, rate_limited
 from retina_tpu.metrics import get_metrics
 from retina_tpu.models.identity import HostIdentityTable, IdentityMap
 from retina_tpu.models.pipeline import PipelineConfig
@@ -45,6 +45,10 @@ from retina_tpu.parallel.partition import (
 )
 from retina_tpu.parallel.telemetry import ShardedTelemetry, topk_from_snapshot
 from retina_tpu.plugins.api import QueueSink
+from retina_tpu.runtime import faults
+from retina_tpu.runtime.supervisor import (
+    Heartbeat, Supervisor, policy_from_config,
+)
 from retina_tpu.utils.device_proxy import (
     fence, fetch_on_device, run_on_device, submit_on_device,
 )
@@ -81,9 +85,15 @@ def pipeline_config_from(cfg: Config) -> PipelineConfig:
 class SketchEngine:
     """Owns device state + the feed/window loop; thread-safe facade."""
 
-    def __init__(self, cfg: Config, devices: Optional[list] = None):
+    def __init__(self, cfg: Config, devices: Optional[list] = None,
+                 supervisor: Optional[Supervisor] = None):
         self.cfg = cfg
         self.log = logger("engine")
+        # Supervision (runtime/supervisor.py): when attached, every
+        # long-lived engine thread registers a heartbeat with the
+        # shared watchdog; standalone engines (tests, bench) get
+        # detached Heartbeat cells that nothing scans.
+        self._supervisor = supervisor
         self.sink = QueueSink(max_blocks=1024)
         self.pcfg = pipeline_config_from(cfg)
         if (
@@ -218,6 +228,11 @@ class SketchEngine:
         # sees the None sentinel (already consumed) and parks forever.
         self._harvest_retired = False
         self._harvest_lock = threading.Lock()
+        # Bumped by _restart_harvest when the watchdog replaces a hung
+        # harvest thread: a superseded instance exits after finishing
+        # (or abandoning) its current item instead of racing the
+        # replacement for the queue forever.
+        self._harvest_gen = 0
         self._warm_thread: threading.Thread | None = None
         # Set once the background warm has made the window-close
         # program resident (or terminally failed to): until then, while
@@ -243,6 +258,187 @@ class SketchEngine:
         self._steps = 0
         self._events_in = 0
         self._closed_events_in = 0
+        # Crash-only recovery (runtime/supervisor.py wiring): while
+        # _degraded is set, async dispatches drop-and-count (stage
+        # "degraded") instead of touching device state mid-rebuild;
+        # recovery_failed latches when the recovery loop's circuit
+        # opens — /healthz goes unhealthy and the orchestrator owns
+        # the restart from there.
+        self._degraded = threading.Event()
+        self._recover_lock = threading.Lock()
+        self._recovering = False
+        self._recover_thread: threading.Thread | None = None
+        self.recovery_failed = threading.Event()
+        self.restarts = 0
+        self._last_resume_src = ""
+        self._snapshot_path = (
+            os.path.join(cfg.snapshot_dir, "sketch_state.npz")
+            if cfg.snapshot_dir else None
+        )
+
+    # -- supervision helpers ------------------------------------------
+    def _register_hb(
+        self, name: str, deadline_s: float | None = None,
+        on_stall: Optional[Callable[[], None]] = None,
+    ) -> Heartbeat:
+        dl = deadline_s or self.cfg.watchdog_deadline_s
+        if self._supervisor is not None:
+            return self._supervisor.register(name, dl, on_stall)
+        return Heartbeat(name, dl, on_stall)
+
+    def _deregister_hb(self, name: str) -> None:
+        if self._supervisor is not None:
+            self._supervisor.deregister(name)
+
+    def _count_error(self, site: str) -> bool:
+        """Broad-except audit contract: every swallowed exception bumps
+        engine_errors{site} unconditionally; returns True when the
+        caller should also emit its (rate-limited) log line."""
+        get_metrics().engine_errors.labels(site=site).inc()
+        return rate_limited(f"engine.{site}")
+
+    # -- crash-only recovery ------------------------------------------
+    @property
+    def degraded(self) -> bool:
+        return self._degraded.is_set()
+
+    @staticmethod
+    def _fatal_device_error(e: BaseException) -> bool:
+        """Classify a step/transfer failure: fatal (device/runtime —
+        the resident state is suspect, rebuild it) vs a bad-batch
+        one-off (already dropped + counted; carry on)."""
+        if isinstance(e, faults.InjectedFault):
+            return True
+        if type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError"):
+            return True
+        msg = str(e).lower()
+        return any(
+            s in msg
+            for s in ("device", "transfer failed", "dma",
+                      "resource exhausted", "data loss")
+        )
+
+    def _request_recovery(self, reason: str) -> None:
+        """Enter degraded drop-and-count mode and kick the recovery
+        thread. Idempotent: concurrent fatal errors fold into the one
+        in-flight recovery."""
+        with self._recover_lock:
+            if self._recovering or self.recovery_failed.is_set():
+                return
+            self._recovering = True
+        self._degraded.set()
+        get_metrics().degraded_mode.set(1)
+        self.log.error(
+            "engine entering DEGRADED mode (crash-only recovery): %s",
+            reason,
+        )
+        t = threading.Thread(
+            target=self._recover, name="engine-recover", daemon=True
+        )
+        self._recover_thread = t
+        t.start()
+
+    def _recover(self) -> None:
+        """Crash-only engine recovery: fence the proxy, tear down and
+        rebuild device state, resume from the last periodic checkpoint
+        (cold start when there is none), re-warm with a probe dispatch,
+        then leave degraded mode. Retries under the restart policy; an
+        open circuit latches recovery_failed (unhealthy)."""
+        t0 = time.monotonic()
+        hb = self._register_hb("engine-recover")
+        policy = policy_from_config(self.cfg, seed_key="engine-recover")
+        m = get_metrics()
+        attempt = 0
+        try:
+            while True:
+                attempt += 1
+                hb.beat()
+                policy.note_start()
+                try:
+                    self._recover_once(hb)
+                    break
+                except Exception:
+                    if self._count_error("recovery"):
+                        self.log.exception(
+                            "engine recovery attempt %d failed", attempt
+                        )
+                    delay = policy.record_failure()
+                    if delay is None:
+                        self.log.error(
+                            "engine recovery crash-looping; giving up "
+                            "(unhealthy until the orchestrator restarts "
+                            "the agent)"
+                        )
+                        self.recovery_failed.set()
+                        return
+                    hb.park()
+                    time.sleep(delay)
+            self._degraded.clear()
+            m.degraded_mode.set(0)
+            m.engine_restarts.inc()
+            self.restarts += 1
+            dt = time.monotonic() - t0
+            m.recovery_seconds.observe(dt)
+            self.log.warning(
+                "engine recovered in %.2fs (attempt %d, %s)",
+                dt, attempt, self._last_resume_src,
+            )
+        finally:
+            with self._recover_lock:
+                self._recovering = False
+            self._deregister_hb("engine-recover")
+
+    def _recover_once(self, hb: Heartbeat) -> None:
+        # Injection site for chaos tests: lets a test hold the engine in
+        # degraded mode deterministically (recover:hangN) to observe the
+        # drop-and-count path, or fail attempts (recover:raise).
+        faults.inject("recover")
+        # 1) Drain the proxy queue: no stale closure may touch the
+        #    state we are about to replace. Bounded — a wedged proxy
+        #    fails this attempt and the policy retries.
+        hb.park()
+        if not fence(timeout=self.cfg.watchdog_deadline_s):
+            raise RuntimeError("device proxy did not drain for recovery")
+        hb.beat()
+        path = self._snapshot_path
+
+        def rebuild():
+            # Device-resident scalars + descriptor table are rebuilt
+            # lazily by the next dispatch; the flow dictionary resyncs
+            # (epoch bump drops queued pre-recovery batches).
+            self._zero_u32 = None
+            self._api_val = -1
+            self._desc_table = None
+            if self._flow_dict is not None:
+                with self._fd_lock:
+                    self._flow_dict.clear()
+                    self._fd_epoch += 1
+            resumed = False
+            if path:
+                from retina_tpu.checkpoint import load_state
+
+                state, resumed = load_state(path, self.sharded, self.pcfg)
+            else:
+                state = self.sharded.init_state()
+            with self._state_lock:
+                self.state = state
+            return resumed
+
+        hb.park()  # rebuild may recompile init_state on a cold cache
+        resumed = run_on_device(rebuild)
+        hb.beat()
+        self._last_resume_src = (
+            f"resumed from {path}" if resumed else "cold start"
+        )
+        # 2) Probe: one zero-batch dispatch through the real transfer +
+        #    step path proves the device works end to end before async
+        #    traffic is readmitted.
+        hb.park()
+        self._dispatch(
+            np.zeros((0, NUM_FIELDS), np.uint32),
+            now_s=int(time.time()), record_metrics=False,
+        )
+        hb.beat()
 
     # -- identity / filter wiring (set by cache & filtermanager) ------
     def update_identities(self, ip_to_index: dict[int, int]) -> None:
@@ -535,6 +731,7 @@ class SketchEngine:
             t0 = time.perf_counter()
             n_warmed = 0
             n_failed = 0
+            hb = self._register_hb("engine-bucket-warm")
             # Bounded duty-cycle scheduler: after each warmed key the
             # thread yields cost*(1-d)/d seconds (capped below) so live
             # dispatches interleave. d=0.5 is the historical equal
@@ -550,15 +747,20 @@ class SketchEngine:
                         continue
                     ok = True
                     tk = time.perf_counter()
+                    # A cold-cache trace+lower legitimately parks the
+                    # proxy for 30-100s — parked, not stalled.
+                    hb.park()
                     try:
                         run_on_device(fn, *args)
                         n_warmed += 1
                     except Exception:
                         ok = False
                         n_failed += 1
+                        self._count_error("warm_key")
                         self.log.exception(
                             "background warm failed at %s", key
                         )
+                    hb.beat()
                     if key == "window close":
                         # Resident — or terminally failed, in which
                         # case ticks must stop deferring and take the
@@ -602,7 +804,10 @@ class SketchEngine:
                         n_warmed, time.perf_counter() - t0,
                     )
             except Exception:
+                self._count_error("warm")
                 self.log.exception("background bucket warm died")
+            finally:
+                self._deregister_hb("engine-bucket-warm")
 
         t = threading.Thread(
             target=_warm, name="engine-bucket-warm", daemon=True
@@ -1031,6 +1236,7 @@ class SketchEngine:
         n_valid_total = int(nv_new.sum() + nv_known.sum())
 
         def xfer_and_step():
+            faults.inject("transfer")
             # A failure resync after this batch was built invalidated
             # the table its ids reference — drop rather than gather
             # zeroed descriptors (FIFO makes ordinary overflow clears
@@ -1145,8 +1351,9 @@ class SketchEngine:
         def safe_xfer_and_step():
             try:
                 xfer_and_step()
-            except Exception:
-                self.log.exception("flow-dict device step failed")
+            except Exception as e:
+                if self._count_error("device_step"):
+                    self.log.exception("flow-dict device step failed")
                 get_metrics().lost_events.labels(
                     stage="device", plugin="engine"
                 ).inc(n_events)
@@ -1155,6 +1362,8 @@ class SketchEngine:
                 # re-upload burst, no wrong data); queued batches from
                 # this epoch self-drop.
                 self._flowdict_resync()
+                if self._fatal_device_error(e):
+                    self._request_recovery(repr(e))
             finally:
                 with self._busy_lock:
                     self._inflight_busy -= 1
@@ -1181,6 +1390,12 @@ class SketchEngine:
         """Pack + device_put + step dispatch for an already-partitioned
         batch.
 
+        Degraded drop-and-count: while a crash-only recovery is
+        rebuilding device state, async feed traffic must not race the
+        rebuild — it drops here, counted under lost_events
+        stage="degraded". Sync dispatches pass through (the recovery
+        probe itself, and direct callers who want the error).
+
         Packing stays on the CALLING thread (the dispatch worker under
         the feed loop), overlapping the proxy thread's in-flight
         transfer. ``sync=True`` (tests, direct callers) blocks on the
@@ -1189,6 +1404,12 @@ class SketchEngine:
         the in-flight semaphore, so transfers run back-to-back on the
         link while this thread packs the next quantum.
         """
+        if not sync and self._degraded.is_set():
+            if record_metrics:
+                get_metrics().lost_events.labels(
+                    stage="degraded", plugin="engine"
+                ).inc(int(sb.events) + int(sb.lost))
+            return
         # The dictionary pays off per ROW saved; a tiny flush (idle
         # agent, interval flush) is cheaper as one plain transfer than
         # as a new/known pair of dispatches. Plain and dict flushes
@@ -1213,7 +1434,8 @@ class SketchEngine:
                     get_metrics().lost_events.labels(
                         stage="dispatch", plugin="engine"
                     ).inc(int(sb.events) + int(sb.lost))
-                    self.log.exception("flow-dict dispatch failed")
+                    if self._count_error("flowdict_dispatch"):
+                        self.log.exception("flow-dict dispatch failed")
                     return
                 raise
             return
@@ -1245,6 +1467,7 @@ class SketchEngine:
         n_events = int(sb.events)
 
         def xfer_and_step():
+            faults.inject("transfer")
             self._device_consts()
             # Execution-time capture — see _dispatch_flowdict: proxy
             # FIFO order is the table-visibility order.
@@ -1303,11 +1526,14 @@ class SketchEngine:
         def safe_xfer_and_step():
             try:
                 xfer_and_step()
-            except Exception:
-                self.log.exception("device step failed")
+            except Exception as e:
+                if self._count_error("device_step"):
+                    self.log.exception("device step failed")
                 get_metrics().lost_events.labels(
                     stage="device", plugin="engine"
                 ).inc(n_events)
+                if self._fatal_device_error(e):
+                    self._request_recovery(repr(e))
             finally:
                 with self._busy_lock:
                     self._inflight_busy -= 1
@@ -1332,7 +1558,7 @@ class SketchEngine:
         )
         try:
             stacked.copy_to_host_async()
-        except Exception:  # backend without async copy: harvest blocks
+        except Exception:  # noqa: RT101 — backend without async copy: harvest blocks
             pass
         return stacked
 
@@ -1368,13 +1594,34 @@ class SketchEngine:
                 self._harvest_thread is None
                 or not self._harvest_thread.is_alive()
             ):
+                gen = self._harvest_gen
                 self._harvest_thread = threading.Thread(
-                    target=self._harvest_loop, name="window-harvest",
-                    daemon=True,
+                    target=self._harvest_loop, args=(gen,),
+                    name="window-harvest", daemon=True,
                 )
                 self._harvest_thread.start()
 
-    def _harvest_loop(self) -> None:
+    def _restart_harvest(self) -> None:
+        """Watchdog escalation for a hung harvest thread (a wedged
+        device_get on a dead link can block indefinitely): supersede it
+        by bumping the generation and spawn a replacement. The hung
+        instance exits at its next generation check instead of racing
+        the replacement for the queue; its in-flight item publishes
+        late (or never) — window gauges are refreshed by every later
+        window, so staleness self-heals."""
+        with self._harvest_lock:
+            if self._harvest_retired:
+                return
+            self._harvest_gen += 1
+            self._harvest_thread = None
+        get_metrics().thread_restarts.labels(thread="window-harvest").inc()
+        self.log.error(
+            "harvest thread stalled; superseding with a replacement "
+            "(gen %d)", self._harvest_gen,
+        )
+        self._ensure_harvest_thread()
+
+    def _harvest_loop(self, gen: int) -> None:
         """(harvest thread) Block on each closed window's device->host
         readback and publish its gauges. Runs OFF the device-proxy
         thread: on backends without async D2H copies (the tunnel) the
@@ -1382,13 +1629,28 @@ class SketchEngine:
         measured as ~80% of steady-state proxy wall clock when the
         harvest ran proxy-side — parking every queued step behind
         scrape-cadence gauge traffic. FIFO order preserves window
-        order."""
+        order.
+
+        ``gen`` is this instance's generation: when the watchdog
+        supersedes a hung instance (_restart_harvest), the stale one
+        exits at its next check instead of competing for the queue."""
+        hb = self._register_hb(
+            "window-harvest", on_stall=self._restart_harvest
+        )
         while True:
-            item = self._harvest_q.get()
+            hb.park()
+            try:
+                item = self._harvest_q.get(timeout=1.0)
+            except queue_mod.Empty:
+                if self._harvest_gen != gen:
+                    return  # superseded while idle
+                continue
+            hb.beat()
             try:
                 if item is None:
                     return
                 kind, stacked = item
+                faults.inject("harvest")
                 if kind == "zero":
                     z = np.zeros((3,), np.float32)
                     self._publish_window({
@@ -1406,14 +1668,22 @@ class SketchEngine:
                         "zscore": host[2],
                     })
             except Exception:
-                self.log.exception("window readback failed")
+                if self._count_error("harvest_readback"):
+                    self.log.exception("window readback failed")
             finally:
                 self._harvest_q.task_done()
+            if self._harvest_gen != gen:
+                # Superseded mid-item (the watchdog already spawned a
+                # replacement): bow out after finishing this one.
+                return
 
-    def _harvest_window(self, timeout: float = 30.0) -> None:
+    def _harvest_window(self, timeout: float | None = None) -> None:
         """Drain pending window readbacks (shutdown / tests): returns
         once every window enqueued so far has published, or after
-        ``timeout`` (a wedged link must not hang shutdown)."""
+        ``timeout`` (default cfg.harvest_timeout_s — a wedged link must
+        not hang shutdown)."""
+        if timeout is None:
+            timeout = self.cfg.harvest_timeout_s
         deadline = time.monotonic() + timeout
         while (
             self._harvest_q.unfinished_tasks
@@ -1458,6 +1728,12 @@ class SketchEngine:
             # dead or finished warm never defers a close.
             get_metrics().windows_deferred.inc()
             return
+        if self._degraded.is_set():
+            # Crash-only recovery in flight: the state is mid-rebuild;
+            # defer exactly like the warm case — the window stays open
+            # and the next tick closes it against recovered state.
+            get_metrics().windows_deferred.inc()
+            return
         if self._events_in == self._closed_events_in:
             get_metrics().windows_closed.inc()
             # Mirror what a real empty close reports (flag 0, z 0,
@@ -1496,8 +1772,11 @@ class SketchEngine:
         def safe_close():
             try:
                 self._close_window()
-            except Exception:
-                self.log.exception("window close failed")
+            except Exception as e:
+                if self._count_error("window_close"):
+                    self.log.exception("window close failed")
+                if self._fatal_device_error(e):
+                    self._request_recovery(repr(e))
             finally:
                 self._inflight.release()
 
@@ -1575,21 +1854,33 @@ class SketchEngine:
         backlog keeps the host->device link busy back-to-back
         (VERDICT r2 weak #1, r3 weak #1). ``q`` is either the inline
         feed's queue.Queue or a feed-pool TransferMux — both block on
-        ``get()`` and deliver ``None`` as the shutdown sentinel."""
-        while True:
-            item = q.get()
-            if item is None:
-                return
-            kind, payload, now_s, n_raw = item
-            try:
-                if kind == "step":
-                    self._dispatch_sharded(
-                        payload, now_s, n_raw, sync=False
-                    )
-                else:
-                    self._submit_close_window()
-            except Exception:
-                self.log.exception("%s dispatch failed", kind)
+        ``get()`` and deliver ``None`` as the shutdown sentinel. The
+        bounded-timeout get keeps the watchdog heartbeat honest: the
+        thread parks before each wait and beats only when processing."""
+        hb = self._register_hb("engine-dispatch")
+        try:
+            while True:
+                hb.park()
+                try:
+                    item = q.get(timeout=1.0)
+                except queue_mod.Empty:
+                    continue
+                hb.beat()
+                if item is None:
+                    return
+                kind, payload, now_s, n_raw = item
+                try:
+                    if kind == "step":
+                        self._dispatch_sharded(
+                            payload, now_s, n_raw, sync=False
+                        )
+                    else:
+                        self._submit_close_window()
+                except Exception:
+                    if self._count_error("dispatch"):
+                        self.log.exception("%s dispatch failed", kind)
+        finally:
+            self._deregister_hb("engine-dispatch")
 
     def start(self, stop: threading.Event) -> None:
         """Feed loop: drain sink → combine → partition → device; close
@@ -1665,7 +1956,7 @@ class SketchEngine:
                     try:
                         q.put(item, timeout=1.0)
                         return
-                    except queue_mod.Full:
+                    except queue_mod.Full:  # noqa: RT101 — liveness re-check loop
                         pass
             elif item[0] == "step":
                 self._dispatch_sharded(item[1], item[2], item[3])
@@ -1675,8 +1966,11 @@ class SketchEngine:
                     # harvest's device_get) never runs concurrently
                     # with proxied step dispatches.
                     self._close_window()
-                except Exception:
-                    self.log.exception("window close failed")
+                except Exception as e:
+                    if self._count_error("window_close"):
+                        self.log.exception("window close failed")
+                    if self._fatal_device_error(e):
+                        self._request_recovery(repr(e))
 
         if depth > 0:
             if n_workers > 1:
@@ -1691,6 +1985,11 @@ class SketchEngine:
                     busy=self._busy_count,
                     alive=lambda: (
                         worker is not None and worker.is_alive()
+                    ),
+                    register_hb=self._register_hb,
+                    deregister_hb=self._deregister_hb,
+                    restart_policy=lambda name: policy_from_config(
+                        self.cfg, seed_key=name
                     ),
                 )
                 self._feed_pool = pool
@@ -1771,15 +2070,20 @@ class SketchEngine:
                         per["part"] * 1e3, per["submit"] * 1e3,
                     )
 
+        hb_feed = self._register_hb("engine-feed")
         try:
             while not stop.is_set():
+                hb_feed.beat()
                 blocks = self.sink.drain(max_blocks=64)
                 for rec, plugin in blocks:
                     for obs in self._observers:
                         try:
                             obs(rec, plugin)
                         except Exception:
-                            self.log.exception("observer failed")
+                            # Observers run per block — a persistently
+                            # failing one must not log at feed rate.
+                            if self._count_error("observer"):
+                                self.log.exception("observer failed")
                     if pool is not None:
                         # Sharded mode: deal the block to a worker and
                         # move on — the distributor NEVER blocks on a
@@ -1821,6 +2125,8 @@ class SketchEngine:
                 if not blocks:
                     stop.wait(0.002)
         finally:
+            hb_feed.park()
+            self._deregister_hb("engine-feed")
             if pool is not None:
                 # Stop the workers FIRST so their final flushes land in
                 # the transfer mux, then send the sentinel down the
@@ -1852,6 +2158,7 @@ class SketchEngine:
                 try:
                     self._harvest_window()
                 except Exception:
+                    self._count_error("harvest_final")
                     self.log.exception("final window harvest failed")
             # Retire the harvest thread (it closes over self: left
             # parked on the queue it would pin the engine object graph
@@ -1960,11 +2267,16 @@ class SketchEngine:
 
         run_on_device(save)
 
-    def load_snapshot_state(self, path: str) -> None:
+    def load_snapshot_state(self, path: str) -> bool:
+        """Restore sketch state from ``path``. Crash-only: a missing or
+        unusable checkpoint cold-starts (quarantined by load_state) —
+        returns True only when state was actually resumed."""
         from retina_tpu.checkpoint import load_state
 
         def load():
+            state, resumed = load_state(path, self.sharded, self.pcfg)
             with self._state_lock:
-                self.state = load_state(path, self.sharded, self.pcfg)
+                self.state = state
+            return resumed
 
-        run_on_device(load)
+        return run_on_device(load)

@@ -104,7 +104,7 @@ class HubbleServer:
 
             try:
                 os.unlink(unix_socket)
-            except OSError:
+            except OSError:  # noqa: RT101 — stale socket may not exist
                 pass
             self._server.add_insecure_port(f"unix:{unix_socket}")
 

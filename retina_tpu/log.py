@@ -12,6 +12,8 @@ from __future__ import annotations
 import logging
 import logging.handlers
 import sys
+import threading
+import time
 
 _ROOT = "retina"
 _configured = False
@@ -72,9 +74,33 @@ def logger(name: str = "") -> logging.Logger:
     return logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)
 
 
+_rl_lock = threading.Lock()
+_rl_last: dict = {}
+
+
+def rate_limited(key: str, interval_s: float = 60.0) -> bool:
+    """True when the caller should emit a log line for ``key`` now.
+
+    Error paths on the hot dispatch/harvest loops must not turn a
+    persistent fault into a log flood: callers bump their error counter
+    unconditionally and gate the (expensive, possibly per-event) log
+    line behind this. First hit always logs; repeats within
+    ``interval_s`` are suppressed.
+    """
+    now = time.monotonic()
+    with _rl_lock:
+        last = _rl_last.get(key)
+        if last is not None and now - last < interval_s:
+            return False
+        _rl_last[key] = now
+        return True
+
+
 def reset_for_tests() -> None:
     global _configured
     root = logging.getLogger(_ROOT)
     for h in list(root.handlers):
         root.removeHandler(h)
     _configured = False
+    with _rl_lock:
+        _rl_last.clear()

@@ -22,6 +22,8 @@ from retina_tpu.managers.pluginmanager import PluginManager
 from retina_tpu.managers.watchermanager import WatcherManager
 from retina_tpu.metrics import initialize_metrics
 from retina_tpu.pubsub import PubSub
+from retina_tpu.runtime import faults
+from retina_tpu.runtime.supervisor import Supervisor, policy_from_config
 from retina_tpu.server import Server
 from retina_tpu.telemetry import new_telemetry
 from retina_tpu.watchers.apiserver import ApiServerWatcher
@@ -34,7 +36,14 @@ class ControllerManager:
         self.cfg = cfg
         self.pubsub = PubSub()
         self.metrics = initialize_metrics()
-        self.engine = SketchEngine(cfg)
+        # Root of the supervision tree: every long-lived thread (feed,
+        # dispatch, harvest, warm, plugins, checkpointer) registers a
+        # heartbeat; the watchdog escalates stalls past the deadline.
+        self.supervisor = Supervisor(
+            deadline_s=cfg.watchdog_deadline_s,
+            interval_s=cfg.watchdog_interval_s,
+        )
+        self.engine = SketchEngine(cfg, supervisor=self.supervisor)
         self.cache = Cache(self.pubsub, max_pods=cfg.n_pods)
         self.filtermanager = FilterManager(self.engine.update_filter_ips)
         self.pluginmanager = PluginManager(
@@ -52,7 +61,8 @@ class ControllerManager:
             )
         self.watchermanager = WatcherManager(watchers)
         self.telemetry = new_telemetry(
-            cfg.enable_telemetry, cfg.telemetry_interval_s
+            cfg.enable_telemetry, cfg.telemetry_interval_s,
+            extra=self.supervisor.summary,
         )
         self.server: Optional[Server] = None
         self._ready = threading.Event()
@@ -84,7 +94,10 @@ class ControllerManager:
         self.server = Server(
             self.cfg.api_server_addr,
             ready_check=self._ready.is_set,
-            healthy_check=lambda: not self.pluginmanager.failed,
+            healthy_check=lambda: not (
+                self.pluginmanager.failed
+                or self.engine.recovery_failed.is_set()
+            ),
             metrics_cache_ttl_s=self.cfg.metrics_cache_ttl_s,
         )
         self.server.expose_var("pods", self.cache.pod_count)
@@ -94,8 +107,16 @@ class ControllerManager:
                 "steps": self.engine._steps,
                 "events_in": self.engine._events_in,
                 "devices": self.engine.n_devices,
+                "degraded": self.engine.degraded,
+                "restarts": self.engine.restarts,
+                "recovery_failed": self.engine.recovery_failed.is_set(),
             }
         )
+        self.server.expose_var("supervisor", self.supervisor.stats)
+        self.server.expose_var(
+            "plugin_supervision", self.pluginmanager.supervision_stats
+        )
+        self.server.expose_var("faults", faults.stats)
         self.server.expose_var(
             "heartbeat", lambda: self.telemetry.last_heartbeat
         )
@@ -144,6 +165,7 @@ class ControllerManager:
         """Run everything; returns when ``stop`` fires (errgroup shape)."""
         assert self.server is not None, "call init() first"
         self.server.start()
+        self.supervisor.start()
         self.telemetry.start_heartbeat()
         self.watchermanager.start(stop)
         self._engine_thread = threading.Thread(
@@ -156,8 +178,32 @@ class ControllerManager:
         # The rest of the bucket grid compiles AFTER ready, interleaved
         # with live dispatches (VERDICT r4 #2: boot SLA over grid warm).
         self._warm_thread = self.engine.start_background_warm(stop)
+        if self.cfg.snapshot_dir and self.cfg.snapshot_interval_s > 0:
+            self.supervisor.spawn(
+                "checkpointer",
+                lambda: self._checkpoint_loop(stop),
+                stop,
+                policy_from_config(self.cfg, seed_key="checkpointer"),
+            )
         stop.wait()
         self.shutdown()
+
+    def _checkpoint_loop(self, stop: threading.Event) -> None:
+        """Periodic state snapshot; the shutdown save is the last line of
+        defense, this bounds how much a crash-only recovery can lose."""
+        path = f"{self.cfg.snapshot_dir}/sketch_state.npz"
+        hb = self.supervisor.register("checkpointer")
+        try:
+            while True:
+                hb.park()
+                if stop.wait(self.cfg.snapshot_interval_s):
+                    return
+                hb.beat()
+                if self.engine.degraded:
+                    continue  # don't snapshot mid-recovery
+                self.engine.save_snapshot_state(path)
+        finally:
+            self.supervisor.deregister("checkpointer")
 
     def shutdown(self) -> None:
         self._ready.clear()
@@ -192,6 +238,7 @@ class ControllerManager:
                     self._log.exception("shutdown state snapshot failed")
         if self.server is not None:
             self.server.stop()
+        self.supervisor.stop()
         self.telemetry.stop()
         self.pubsub.shutdown()
         self._log.info("agent shut down")

@@ -11,6 +11,14 @@ Differences by design: plugins raising UnsupportedPlatform at reconcile
 are skipped with a warning (the reference compiles them out per-OS);
 reconcile failures are counted in the same
 plugin_manager_failed_to_reconcile series.
+
+Supervision: unlike the reference errgroup (one crash tears the whole
+agent down), each plugin runs under a restart loop with exponential
+backoff and a crash-loop circuit breaker. A crashing plugin is restarted
+in place; only a plugin whose circuit opens (persistently crash-looping)
+marks the manager ``failed`` so the health endpoint reports unhealthy and
+the orchestrator can restart the pod — the process itself stays up and
+keeps serving the remaining plugins and the engine.
 """
 
 from __future__ import annotations
@@ -25,6 +33,8 @@ from retina_tpu.log import logger
 from retina_tpu.metrics import get_metrics
 from retina_tpu.plugins import registry
 from retina_tpu.plugins.api import EventSink, Plugin, UnsupportedPlatform
+from retina_tpu.runtime import faults
+from retina_tpu.runtime.supervisor import RestartPolicy, policy_from_config
 
 RECONCILE_SLA_S = 10.0  # pluginmanager.go:25-28
 
@@ -44,6 +54,7 @@ class PluginManager:
         self._threads: dict[str, threading.Thread] = {}
         self._stop = threading.Event()
         self._fatal = threading.Event()
+        self._policies: dict[str, RestartPolicy] = {}
 
         import retina_tpu.plugins  # noqa: F401  (self-registration)
 
@@ -101,29 +112,77 @@ class PluginManager:
     # -- start/stop (pluginmanager.go:116-193) -------------------------
     def start(self, stop: threading.Event) -> None:
         """Reconcile + launch every plugin; returns once all are running.
-        Any plugin's crash sets ``stop`` (errgroup semantics)."""
+
+        Each plugin runs under a supervised restart loop: a crash is
+        restarted with exponential backoff; a crash-looping plugin trips
+        its circuit breaker, which marks the manager ``failed`` (and so
+        /healthz unhealthy) without tearing the process down.
+        """
         self._stop = stop
         for name in list(self.plugins):
             self.reconcile(name)
 
-        def run(name: str, p: Plugin) -> None:
-            try:
-                p.start(stop)
-            except UnsupportedPlatform as e:
-                self._log.warning("plugin %s stopped: %s", name, e)
-            except Exception as e:
-                self._log.exception("plugin %s crashed", name)
-                self.errors.append((name, e))
-                self._fatal.set()
-                stop.set()  # tear down the agent for a clean restart
-
         for name, p in self.plugins.items():
+            self._policies[name] = policy_from_config(
+                self.cfg, seed_key=f"plugin.{name}"
+            )
             t = threading.Thread(
-                target=run, args=(name, p), name=f"plugin-{name}", daemon=True
+                target=self._run_supervised,
+                args=(name, p, stop),
+                name=f"plugin-{name}",
+                daemon=True,
             )
             t.start()
             self._threads[name] = t
         self._log.info("started plugins: %s", sorted(self.plugins))
+
+    def _run_supervised(
+        self, name: str, p: Plugin, stop: threading.Event
+    ) -> None:
+        policy = self._policies[name]
+        while not stop.is_set():
+            policy.note_start()
+            try:
+                faults.inject(f"plugin.{name}")
+                p.start(stop)
+                return  # clean exit (stop requested or plugin done)
+            except UnsupportedPlatform as e:
+                self._log.warning("plugin %s stopped: %s", name, e)
+                return
+            except Exception as e:
+                self._log.exception("plugin %s crashed", name)
+                self.errors.append((name, e))
+                del self.errors[:-32]  # bounded crash history
+            delay = policy.record_failure()
+            if delay is None:
+                self._log.error(
+                    "plugin %s circuit OPEN (crash-looping); waiting for "
+                    "half-open probe — /healthz reports unhealthy", name,
+                )
+                if not policy.wait_half_open(stop):
+                    return
+                continue
+            get_metrics().plugin_restarts.labels(plugin=name).inc()
+            self._log.warning(
+                "restarting plugin %s in %.2fs (consecutive crashes: %d)",
+                name, delay, policy.stats()["consecutive_failures"],
+            )
+            # Best-effort teardown + re-init so the restart starts clean.
+            try:
+                p.stop()
+            except Exception:
+                self._log.warning(
+                    "plugin %s stop before restart failed", name,
+                    exc_info=True,
+                )
+            try:
+                p.init()
+            except Exception:
+                self._log.warning(
+                    "plugin %s re-init before restart failed", name,
+                    exc_info=True,
+                )
+            stop.wait(delay)
 
     def stop(self) -> None:
         self._stop.set()
@@ -137,4 +196,18 @@ class PluginManager:
 
     @property
     def failed(self) -> bool:
-        return self._fatal.is_set()
+        """Unhealthy when any plugin's restart circuit is not closed.
+
+        The process stays up either way; ``failed`` is surfaced through
+        /healthz so the orchestrator decides whether to restart the pod.
+        """
+        if self._fatal.is_set():
+            return True
+        return any(
+            pol.state != "closed" for pol in self._policies.values()
+        )
+
+    def supervision_stats(self) -> dict:
+        return {
+            name: pol.stats() for name, pol in sorted(self._policies.items())
+        }

@@ -109,6 +109,19 @@ class Metrics:
             buckets=[1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0, 3.0],
         )
         self.transfer_bytes = c(mn.TRANSFER_BYTES, [])
+        # Supervised-runtime robustness series (runtime/supervisor.py;
+        # see metric_names for semantics).
+        self.engine_restarts = c(mn.ENGINE_RESTARTS, [])
+        self.watchdog_stalls = c(mn.WATCHDOG_STALLS, [mn.L_THREAD])
+        self.plugin_restarts = c(mn.PLUGIN_RESTARTS, [mn.L_PLUGIN])
+        self.thread_restarts = c(mn.THREAD_RESTARTS, [mn.L_THREAD])
+        self.engine_errors = c(mn.ENGINE_ERRORS, [mn.L_SITE])
+        self.degraded_mode = g(mn.DEGRADED_MODE, [])
+        self.recovery_seconds = ex.new_histogram(
+            mn.RECOVERY_SECONDS,
+            [],
+            buckets=[0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 60.0, 120.0],
+        )
         # Device->host bytes (snapshot readbacks): on a serialized
         # tunnel link they share the same pipe as transfer_bytes, so
         # link-utilization math must sum both directions.

@@ -441,7 +441,7 @@ class NativeFlowDict:
     def __del__(self):  # best-effort; close() is the real API
         try:
             self.close()
-        except Exception:
+        except Exception:  # noqa: RT101 — __del__ must never raise; close() is the real API
             pass
 
 

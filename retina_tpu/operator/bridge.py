@@ -122,7 +122,7 @@ class FileBridge:
             self.store.delete(kind, name, ns)
             self._log.info("deleted %s %s/%s (source doc removed)",
                            kind, ns, name)
-        except KeyError:
+        except KeyError:  # noqa: RT101 — already deleted; idempotent reconcile
             pass
 
     def _apply_doc(self, path: str, doc: dict,
@@ -226,7 +226,7 @@ class KubeBridge:
                     kind, meta.get("name", ""),
                     meta.get("namespace", "default"),
                 )
-            except KeyError:
+            except KeyError:  # noqa: RT101 — already deleted; idempotent reconcile
                 pass
 
     def _sync(self, kind: str, metas: list[dict]) -> None:
@@ -241,7 +241,7 @@ class KubeBridge:
             if f"{ns}/{obj.name}" not in listed:
                 try:
                     self.store.delete(kind, obj.name, ns)
-                except KeyError:
+                except KeyError:  # noqa: RT101 — already deleted; resync race
                     pass
 
     def patch_status(self, kind: str, obj: Any) -> None:

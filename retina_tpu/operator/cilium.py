@@ -129,7 +129,7 @@ class CiliumPublisher:
                     try:
                         self._bootstrap_cids.add(
                             int(it.get("metadata", {}).get("name", "")))
-                    except ValueError:
+                    except ValueError:  # noqa: RT101 — non-numeric CID name; skip entry
                         pass
             if self._bootstrap_cids:
                 self.alloc._next = max(self.alloc._next,

@@ -177,34 +177,84 @@ class FeedWorker(threading.Thread):
 
     # -- worker side --------------------------------------------------
     def run(self) -> None:
+        """Supervised run: the ingest loop restarts under the pool's
+        restart policy when it crashes (staging survives — it lives on
+        the worker object, not the loop frame); a crash loop gives up
+        and lets the distributor's liveness check route blocks to the
+        surviving shards."""
+        hb = (
+            self.pool.register_hb(self.name)
+            if self.pool.register_hb is not None else None
+        )
+        policy = (
+            self.pool.restart_policy(self.name)
+            if self.pool.restart_policy is not None else None
+        )
         try:
             while True:
-                stopping = self.pool.stop_evt.is_set()
-                pend = self.pending_events()
-                if pend == 0:
-                    if stopping:
+                try:
+                    self._loop(hb)
+                    return
+                except Exception:
+                    from retina_tpu.metrics import get_metrics
+
+                    get_metrics().engine_errors.labels(
+                        site="feed_worker"
+                    ).inc()
+                    delay = (
+                        policy.record_failure()
+                        if policy is not None else None
+                    )
+                    if delay is None:
+                        _log.exception(
+                            "feed worker %d crash-looping; giving up "
+                            "(blocks route to surviving shards)",
+                            self.idx,
+                        )
                         return
-                    self.wake.wait(0.002)
-                    self.wake.clear()
-                    continue
-                age = time.monotonic() - self.first_t
-                # Same flush policy as the inline feed: full quantum,
-                # or the hard age bound, or an interval flush when the
-                # dispatch pipeline is idle (latency priority only when
-                # nothing is in flight).
-                if not (
-                    pend >= self.pool.quantum
-                    or stopping
-                    or age >= self.pool.flush_max_age_s
-                    or (age >= self.pool.flush_interval_s
-                        and self.pool.busy() == 0)
-                ):
-                    self.wake.wait(0.002)
-                    self.wake.clear()
-                    continue
-                self._flush()
-        except Exception:
-            _log.exception("feed worker %d died", self.idx)
+                    _log.exception(
+                        "feed worker %d crashed; restart in %.2fs",
+                        self.idx, delay,
+                    )
+                    get_metrics().thread_restarts.labels(
+                        thread=self.name
+                    ).inc()
+                    if self.pool.stop_evt.wait(delay):
+                        return
+        finally:
+            if self.pool.deregister_hb is not None:
+                self.pool.deregister_hb(self.name)
+
+    def _loop(self, hb) -> None:
+        while True:
+            stopping = self.pool.stop_evt.is_set()
+            pend = self.pending_events()
+            if pend == 0:
+                if stopping:
+                    return
+                if hb is not None:
+                    hb.park()
+                self.wake.wait(0.002)
+                self.wake.clear()
+                continue
+            if hb is not None:
+                hb.beat()
+            age = time.monotonic() - self.first_t
+            # Same flush policy as the inline feed: full quantum,
+            # or the hard age bound, or an interval flush when the
+            # dispatch pipeline is idle (latency priority only when
+            # nothing is in flight).
+            if not (
+                pend >= self.pool.quantum
+                or stopping
+                or age >= self.pool.flush_max_age_s
+                or (age >= self.pool.flush_interval_s
+                    and self.pool.busy() == 0)
+            ):
+                self.wake.wait(0.002)
+                self.wake.clear()
+                continue
+            self._flush()
 
     def _flush(self) -> None:
         blocks = []
@@ -281,6 +331,9 @@ class FeedWorkerPool:
         busy: Callable[[], int] = lambda: 0,
         alive: Callable[[], bool] = lambda: True,
         depth: int = TRANSFER_DEPTH,
+        register_hb: Optional[Callable[[str], Any]] = None,
+        deregister_hb: Optional[Callable[[str], None]] = None,
+        restart_policy: Optional[Callable[[str], Any]] = None,
     ):
         self.quantum = max(1, int(quantum))
         self.staging_blocks = max(1, int(staging_blocks))
@@ -291,6 +344,12 @@ class FeedWorkerPool:
         self.busy = busy
         self.alive = alive
         self.depth = max(1, int(depth))
+        # Supervision seams (engine passes its heartbeat registrar and
+        # config-derived restart policy factory; bare pools run
+        # unsupervised exactly as before).
+        self.register_hb = register_hb
+        self.deregister_hb = deregister_hb
+        self.restart_policy = restart_policy
         self.stop_evt = threading.Event()
         data = threading.Event()
         self.workers = [

@@ -95,6 +95,6 @@ class DnsPlugin(Plugin):
 
             try:
                 get_pubsub().unsubscribe(TOPIC_DNS_NAMES, self._sub)
-            except KeyError:
+            except KeyError:  # noqa: RT101 — unsubscribe after pubsub shutdown
                 pass
             self._sub = None

@@ -39,7 +39,7 @@ class ExternalEventsPlugin(Plugin):
         path = self.cfg.external_socket
         try:
             os.unlink(path)
-        except OSError:
+        except OSError:  # noqa: RT101 — stale socket may not exist
             pass
         self._server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._server.bind(path)
@@ -88,5 +88,5 @@ class ExternalEventsPlugin(Plugin):
             self._server = None
             try:
                 os.unlink(self.cfg.external_socket)
-            except OSError:
+            except OSError:  # noqa: RT101 — socket already removed
                 pass

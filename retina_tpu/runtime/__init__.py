@@ -1,0 +1,4 @@
+"""Supervised-runtime primitives: heartbeat watchdog, restart policies
+with circuit breaking, and the deterministic fault-injection layer the
+chaos suite drives (ROADMAP: crash-only posture for the metrics path).
+"""

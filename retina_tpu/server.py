@@ -212,13 +212,13 @@ class Server:
                         self._send(200, "\n".join(lines).encode(), "text/plain")
                     else:
                         self._send(404, b"not found", "text/plain")
-                except BrokenPipeError:
+                except BrokenPipeError:  # noqa: RT101 — client hung up mid-response
                     pass
                 except Exception:
                     _log.exception("handler error path=%s", self.path)
                     try:
                         self._send(500, b"internal error", "text/plain")
-                    except Exception:
+                    except Exception:  # noqa: RT101 — 500 write raced the hangup; already logged
                         pass
 
         self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)

@@ -35,10 +35,14 @@ class Telemetry:
         interval_s: float = 900.0,
         exporter: Optional[Exporter] = None,
         properties: Optional[dict[str, str]] = None,
+        extra: Optional[Any] = None,
     ) -> None:
         self._interval = interval_s
         self._exporter = exporter or get_exporter()
         self._props = dict(properties or {})
+        # Optional zero-arg callable merged into every heartbeat —
+        # used for the supervisor's thread/stall summary.
+        self._extra = extra
         self._proc = psutil.Process()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -63,6 +67,11 @@ class Telemetry:
                 "num_threads": self._proc.num_threads(),
                 **self._props,
             }
+        if self._extra is not None:
+            try:
+                hb.update(self._extra())
+            except Exception:
+                _log.warning("telemetry extra callable failed", exc_info=True)
         self.last_heartbeat = hb
         _log.info(
             "heartbeat cardinality=%d rss_mb=%.1f threads=%d",

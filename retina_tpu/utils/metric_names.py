@@ -91,6 +91,22 @@ L_WORKER = "worker"
 # the background warm (engine._close_window_impl): the window stays
 # open instead of cold-compiling end_window inline mid-feed.
 WINDOWS_DEFERRED = PREFIX + "tpu_windows_deferred"
+# Supervised-runtime robustness counters (runtime/supervisor.py).
+# engine_restarts counts full crash-only engine recoveries (device
+# state rebuilt, resumed from the last checkpoint); watchdog_stalls
+# counts missed-heartbeat escalations per thread; plugin_restarts and
+# thread_restarts count supervised restarts of plugin runners and of
+# engine-internal threads; engine_errors is the named-counter side of
+# the broad-except audit (every swallow bumps a site label);
+# degraded_mode is 1 while the engine is dropping-and-counting during
+# a recovery; recovery_seconds is the teardown→re-warm→resume latency.
+ENGINE_RESTARTS = PREFIX + "tpu_engine_restarts"
+WATCHDOG_STALLS = PREFIX + "watchdog_stalls_counter"
+PLUGIN_RESTARTS = PREFIX + "plugin_restarts_counter"
+THREAD_RESTARTS = PREFIX + "thread_restarts_counter"
+ENGINE_ERRORS = PREFIX + "engine_errors_counter"
+DEGRADED_MODE = PREFIX + "tpu_degraded_mode"
+RECOVERY_SECONDS = PREFIX + "tpu_recovery_seconds"
 DEVICE_STEP_SECONDS = PREFIX + "tpu_step_seconds"
 DEVICE_BATCH_FILL = PREFIX + "tpu_batch_fill_ratio"
 WINDOWS_CLOSED = PREFIX + "tpu_windows_closed"
@@ -116,6 +132,8 @@ L_STAGE = "stage"
 L_TABLE = "table"
 L_PLUGIN = "plugin"
 L_STATE = "state"
+L_THREAD = "thread"
+L_SITE = "site"
 L_INTERFACE = "interface_name"
 L_STAT = "statistic_name"
 L_BUCKET = "le_ms"

@@ -209,16 +209,52 @@ def test_pluginmanager_reconcile_failure_counts():
     assert v == 1
 
 
-def test_pluginmanager_crash_sets_stop():
+def test_pluginmanager_crash_restarts_in_place():
+    """Supervised semantics: a single crash restarts the plugin under
+    backoff instead of tearing the agent down (old errgroup behavior)."""
+    from retina_tpu.metrics import get_metrics
+    from retina_tpu.runtime import faults
+
     cfg = Config()
     cfg.enabled_plugins = ["mock"]
+    cfg.restart_backoff_base_s = 0.01
+    cfg.restart_backoff_jitter = 0.0
+    faults.configure("plugin.mock:raise@1")
+    try:
+        pm = PluginManager(cfg)
+        stop = threading.Event()
+        pm.start(stop)
+        p = pm.plugins["mock"]
+        assert p.started.wait(5.0)  # restarted after the injected crash
+        assert not stop.is_set()  # the process stays up
+        assert not pm.failed  # circuit still closed (one crash)
+        v = get_metrics().plugin_restarts.labels(plugin="mock")._value.get()
+        assert v == 1
+        pm.stop()
+    finally:
+        faults.clear()
+
+
+def test_pluginmanager_crash_loop_opens_circuit():
+    """A persistently crashing plugin trips the circuit breaker:
+    ``failed`` turns True (healthz unhealthy) but ``stop`` stays unset —
+    the orchestrator restarts the pod, not us."""
+    cfg = Config()
+    cfg.enabled_plugins = ["mock"]
+    cfg.restart_backoff_base_s = 0.01
+    cfg.restart_backoff_jitter = 0.0
+    cfg.restart_max_failures = 3
     MockPlugin.fail_stage = "start"
     pm = PluginManager(cfg)
     stop = threading.Event()
     pm.start(stop)
-    assert stop.wait(2.0)  # errgroup: crash tears the agent down
+    deadline = time.monotonic() + 5.0
+    while not pm.failed and time.monotonic() < deadline:
+        time.sleep(0.01)
     assert pm.failed
+    assert not stop.is_set()  # crash-only: no in-process teardown
     assert pm.errors and pm.errors[0][0] == "mock"
+    assert pm.supervision_stats()["mock"]["state"] == "open"
     pm.stop()
 
 

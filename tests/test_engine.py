@@ -3,6 +3,7 @@ feed→step→snapshot correctness vs exact numpy baselines, window/anomaly
 closing, filter gating, checkpoint round-trip — the reference's pattern of
 feeding synthetic flows and asserting metric outcomes (SURVEY.md §4)."""
 
+import os
 import threading
 import time
 
@@ -146,16 +147,20 @@ def test_engine_checkpoint_roundtrip(tmp_path):
     eng.save_snapshot_state(path)
 
     eng2 = SketchEngine(cfg)
-    eng2.load_snapshot_state(path)
+    assert eng2.load_snapshot_state(path) is True
     snap = eng2.snapshot(max_age_s=0)
     assert snap["totals"][0] == 100
     assert snap["pod_forward"][1, 0, 0] == 100
 
-    # Config mismatch refuses to load
+    # Config mismatch: crash-only contract — never raises, quarantines
+    # the stale checkpoint to .bad and cold-starts clean.
     cfg3 = small_cfg(cms_width=1 << 9)
     eng3 = SketchEngine(cfg3)
-    with pytest.raises(ValueError):
-        eng3.load_snapshot_state(path)
+    assert eng3.load_snapshot_state(path) is False
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".bad")
+    snap3 = eng3.snapshot(max_age_s=0)
+    assert snap3["totals"][0] == 0
 
 
 def test_engine_drop_accounting_on_overflow():

@@ -1,0 +1,237 @@
+"""Supervision-tree contract tests: heartbeat/stall detection, backoff
+schedule determinism, circuit breaker transitions, supervised spawn
+restarts, and the fault-injection grammar — all clock-driven through
+``scan_once(now)`` / seeded policies, no sleeps beyond short waits."""
+
+import threading
+import time
+
+import pytest
+
+from retina_tpu.config import Config
+from retina_tpu.runtime import faults
+from retina_tpu.runtime.supervisor import (
+    RestartPolicy,
+    Supervisor,
+    policy_from_config,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+
+
+# ------------------------------------------------------------ heartbeat
+def test_watchdog_detects_stall_and_escalates_once_per_deadline():
+    sup = Supervisor(deadline_s=10.0, interval_s=0.1)
+    fired = []
+    hb = sup.register("worker", on_stall=lambda: fired.append(1))
+    t0 = time.monotonic()
+    hb.beat()
+    # Fresh beat: no stall.
+    assert sup.scan_once(now=t0 + 5.0) == []
+    # Past the deadline: escalates exactly once...
+    assert sup.scan_once(now=t0 + 11.0) == ["worker"]
+    assert fired == [1]
+    # ...and not again within the same deadline window...
+    assert sup.scan_once(now=t0 + 12.0) == []
+    # ...but re-fires after another full deadline of silence.
+    assert sup.scan_once(now=t0 + 22.0) == ["worker"]
+    assert hb.stalls == 2
+    # A beat clears the stall state entirely.
+    hb.beat()
+    assert sup.scan_once(now=time.monotonic() + 5.0) == []
+    assert sup.summary()["stalled"] == 0
+    assert sup.summary()["stalls_total"] == 2
+
+
+def test_parked_heartbeat_never_counts_as_stalled():
+    sup = Supervisor(deadline_s=1.0)
+    hb = sup.register("idle")
+    hb.park()  # intentional blocking wait (queue.get etc.)
+    assert sup.scan_once(now=time.monotonic() + 3600.0) == []
+    assert hb.stalls == 0
+
+
+def test_register_is_takeover_and_preserves_stall_count():
+    sup = Supervisor(deadline_s=1.0)
+    hb1 = sup.register("t")
+    hb1.stalls = 3
+    hb2 = sup.register("t")  # replacement thread takes the cell over
+    assert hb2 is not hb1 and hb2.stalls == 3
+    assert sup.heartbeat("t") is hb2
+
+
+def test_on_stall_exception_does_not_kill_the_scan():
+    sup = Supervisor(deadline_s=0.5)
+
+    def boom():
+        raise RuntimeError("escalation handler bug")
+
+    hb = sup.register("bad", on_stall=boom)
+    hb.beat()
+    assert sup.scan_once(now=time.monotonic() + 2.0) == ["bad"]
+
+
+# --------------------------------------------------------- restart policy
+def test_backoff_schedule_is_exponential_and_capped():
+    p = RestartPolicy(base_s=0.1, max_s=0.5, jitter=0.0, max_failures=10)
+    delays = []
+    for _ in range(5):
+        p.note_start()
+        delays.append(p.record_failure())
+    assert delays == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+
+def test_backoff_jitter_is_seeded_and_reproducible():
+    cfg = Config()
+    a = policy_from_config(cfg, seed_key="thread-x")
+    b = policy_from_config(cfg, seed_key="thread-x")
+    for _ in range(3):
+        a.note_start(), b.note_start()
+        assert a.record_failure() == b.record_failure()
+
+
+def test_circuit_opens_after_max_consecutive_failures():
+    p = RestartPolicy(base_s=0.01, jitter=0.0, max_failures=3)
+    p.note_start()
+    assert p.record_failure() is not None
+    p.note_start()
+    assert p.record_failure() is not None
+    p.note_start()
+    assert p.record_failure() is None  # third consecutive crash: OPEN
+    assert p.state == "open"
+
+
+def test_circuit_half_open_probe_then_reopen_on_crash():
+    p = RestartPolicy(base_s=0.01, jitter=0.0, max_failures=1,
+                      half_open_after_s=0.05)
+    p.note_start()
+    assert p.record_failure() is None
+    assert p.state == "open"
+    stop = threading.Event()
+    assert p.wait_half_open(stop) is True
+    assert p.state == "half_open"
+    # The probe crashes: straight back to open, no delay.
+    p.note_start()
+    assert p.record_failure() is None
+    assert p.state == "open"
+
+
+def test_circuit_closes_after_healthy_window():
+    p = RestartPolicy(base_s=0.01, jitter=0.0, max_failures=1,
+                      window_s=0.05, half_open_after_s=0.01)
+    p.note_start()
+    assert p.record_failure() is None
+    assert p.wait_half_open(threading.Event())
+    p.note_start()  # probe run starts...
+    time.sleep(0.08)  # ...and stays healthy past window_s
+    assert p.state == "closed"
+
+
+def test_long_lived_runs_reset_the_consecutive_count():
+    p = RestartPolicy(base_s=0.1, max_s=10.0, jitter=0.0, max_failures=3,
+                      window_s=0.0)  # any run counts as long-lived
+    for _ in range(10):  # sporadic crashes never open the circuit
+        p.note_start()
+        assert p.record_failure() == 0.1  # streak resets every time
+    assert p.state == "closed"
+
+
+def test_wait_half_open_interrupted_by_stop():
+    p = RestartPolicy(max_failures=1, half_open_after_s=60.0)
+    p.note_start()
+    p.record_failure()
+    stop = threading.Event()
+    stop.set()
+    assert p.wait_half_open(stop) is False
+
+
+# ------------------------------------------------------- supervised spawn
+def test_spawn_restarts_crashing_target_until_clean_exit():
+    sup = Supervisor()
+    stop = threading.Event()
+    runs = []
+    done = threading.Event()
+
+    def flaky():
+        runs.append(1)
+        if len(runs) < 3:
+            raise RuntimeError("transient")
+        done.set()
+
+    pol = RestartPolicy(base_s=0.01, jitter=0.0, max_failures=10)
+    t = sup.spawn("flaky", flaky, stop, pol)
+    assert done.wait(5.0)
+    t.join(timeout=2.0)
+    assert len(runs) == 3
+    from retina_tpu.metrics import get_metrics
+
+    v = get_metrics().thread_restarts.labels(thread="flaky")._value.get()
+    assert v == 2
+
+
+def test_spawn_respects_stop_during_backoff():
+    sup = Supervisor()
+    stop = threading.Event()
+
+    def crash():
+        raise RuntimeError("always")
+
+    pol = RestartPolicy(base_s=30.0, jitter=0.0, max_failures=10)
+    t = sup.spawn("crashy", crash, stop, pol)
+    time.sleep(0.1)
+    stop.set()
+    t.join(timeout=2.0)
+    assert not t.is_alive()
+
+
+# ------------------------------------------------------- fault injection
+def test_fault_spec_grammar_and_nth_hit():
+    faults.configure("transfer:raise@2,checkpoint:corrupt")
+    faults.inject("transfer")  # hit 1: pass
+    with pytest.raises(faults.InjectedFault):
+        faults.inject("transfer")  # hit 2: fire
+    faults.inject("transfer")  # later hits pass again (one-shot @N)
+    assert faults.should_corrupt("checkpoint")
+    assert not faults.should_corrupt("transfer")
+    st = faults.stats()
+    assert st["armed"] and st["rules"]["transfer"]["fired"] == 1
+
+
+def test_fault_hang_released_by_clear():
+    faults.configure("loop:hang60")
+    t0 = time.monotonic()
+    done = threading.Event()
+
+    def hanger():
+        faults.inject("loop")
+        done.set()
+
+    threading.Thread(target=hanger, daemon=True).start()
+    time.sleep(0.05)
+    faults.clear()  # frees the hung thread immediately
+    assert done.wait(5.0)
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_fault_spec_rejects_garbage():
+    with pytest.raises(ValueError):
+        faults.configure("transfer;raise")
+    with pytest.raises(ValueError):
+        faults.configure("transfer:explode")
+
+
+def test_config_validates_fault_spec_and_deadlines():
+    cfg = Config()
+    cfg.fault_spec = "transfer:raise@3,plugin.mock:hang2.5"
+    cfg.validate()  # well-formed spec passes
+    cfg.fault_spec = "not a spec"
+    with pytest.raises(ValueError):
+        cfg.validate()
+    cfg.fault_spec = ""
+    cfg.watchdog_deadline_s = 0.0
+    with pytest.raises(ValueError):
+        cfg.validate()

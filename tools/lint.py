@@ -15,10 +15,16 @@ Checks (all precise, no style opinions):
   B011  assert on a non-empty tuple (always true)
   F811  duplicate top-level def/class name
   RT100 threading.Thread spawned in engine.py outside the sanctioned
-        helpers (start, start_background_warm, _ensure_harvest_thread).
+        helpers (start, start_background_warm, _ensure_harvest_thread,
+        _request_recovery).
         Every engine thread must be created where shutdown joins it —
         a thread spawned ad hoc escapes the stop/join protocol and the
         device-proxy single-thread invariant review.
+  RT101 silent exception swallow in retina_tpu/: an `except` handler
+        whose body is only `pass`/`...` hides failures from operators.
+        Every swallow must at least log (rate-limited) and bump a
+        named error counter; a deliberate swallow carries a
+        `# noqa: RT101 — reason` on the except line.
 
 `# noqa` (with or without a code) on the flagged line suppresses it.
 Exit code 1 if any finding. Usage: python tools/lint.py [paths...]
@@ -157,6 +163,7 @@ def check_file(path: Path) -> list[tuple[int, str, str]]:
     if path.name == "engine.py":
         sanctioned = {
             "start", "start_background_warm", "_ensure_harvest_thread",
+            "_request_recovery",
         }
 
         def _walk_fn(node: ast.AST, fn: str | None) -> None:
@@ -179,6 +186,26 @@ def check_file(path: Path) -> list[tuple[int, str, str]]:
                 _walk_fn(child, nxt)
 
         _walk_fn(tree, None)
+
+    # RT101 — silent exception swallows in production code. Handlers
+    # whose body is only pass/... make failures invisible; the
+    # robustness contract is log-once (rate-limited) + named error
+    # counter, or an explicit noqa with a reason.
+    if "retina_tpu" in path.parts:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            body_silent = all(
+                isinstance(stmt, ast.Pass)
+                or (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Constant)
+                    and stmt.value.value is Ellipsis)
+                for stmt in node.body
+            )
+            if body_silent:
+                add(node.lineno, "RT101",
+                    "silent exception swallow (`except ...: pass`) — "
+                    "log + count it, or noqa with a reason")
     return finds
 
 
