@@ -312,6 +312,13 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     cfg.api_server_addr = "127.0.0.1:0"
     cfg.enabled_plugins = ["packetparser"]
     cfg.event_source = "synthetic"
+    # Chaos drills: the bench builds its Config directly (no
+    # load_config env layering), so honor RETINA_FAULT_SPEC here —
+    # e.g. feed.backpressure:press drives the overload controller for
+    # the window_overload/stalled_windows acceptance run.
+    cfg.fault_spec = os.environ.get("RETINA_FAULT_SPEC", "")
+    if cfg.fault_spec:
+        log(f"e2e: fault injection armed: {cfg.fault_spec}")
     cfg.synthetic_rate = 1e12  # unthrottled: measure the system ceiling
     cfg.synthetic_flows = 50_000 if smoke else 1_000_000
     cfg.synthetic_pregen = 16 if smoke else 256  # 131k / 2.1M event ring
@@ -437,10 +444,22 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
             "measuring anyway")
     time.sleep(warmup)
 
+    def _shed_counts() -> dict[str, float]:
+        # Labeled counter: the parent has no _value; read the children
+        # through collect() samples (stage -> cumulative count).
+        out: dict[str, float] = {}
+        for metric in m.events_shed.collect():
+            for s in metric.samples:
+                if s.name.endswith("_total"):
+                    out[s.labels.get("stage", "")] = s.value
+        return out
+
     def measure_window() -> dict:
         ev0 = eng._events_in
         bytes0 = m.transfer_bytes._value.get()
         rb0 = m.readback_bytes._value.get()
+        samp0 = m.events_sampled._value.get()
+        shed0 = _shed_counts()
         t0 = time.monotonic()
         lat: list[float] = []
         while time.monotonic() - t0 < dur:
@@ -451,6 +470,8 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         ev1 = eng._events_in  # one snapshot: rate/events/bpe consistent
         bytes1 = m.transfer_bytes._value.get()
         rb1 = m.readback_bytes._value.get()
+        shed1 = _shed_counts()
+        ov = eng.overload_stats()
         return {
             "rate": (ev1 - ev0) / elapsed,
             "wire_bytes": bytes1 - bytes0,
@@ -458,6 +479,20 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
             "events": ev1 - ev0,
             "elapsed": elapsed,
             "lat": lat,
+            # Per-window overload diagnostics: what the adaptive
+            # controller did to KEEP this window's event count nonzero
+            # (docs/operations.md §6). events_sampled is the
+            # Horvitz-Thompson-rescaled share, not loss.
+            "overload_state": ov["state"],
+            "sample_k": ov["sample_k"],
+            "events_sampled": int(
+                m.events_sampled._value.get() - samp0
+            ),
+            "events_shed": {
+                k: int(v - shed0.get(k, 0.0))
+                for k, v in shed1.items()
+                if v - shed0.get(k, 0.0) > 0
+            },
         }
 
     def _proxy_seconds() -> float:
@@ -505,7 +540,10 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         time.monotonic() - t_win0, 1e-9
     )
     log("e2e: windows "
-        + ", ".join(f"{w['rate'] / 1e6:.2f}M" for w in windows))
+        + ", ".join(
+            f"{w['rate'] / 1e6:.2f}M[{w['overload_state']}]"
+            for w in windows
+        ))
     # Transport-outage windows (below STALL_FLOOR) are excluded from
     # the HEADLINE median but fully disclosed (all window rates + the
     # stall count ride the result): a zeroed window measures the
@@ -557,6 +595,28 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         )
     except Exception:
         pass
+    # Overload-controller diag: per-window state + what sampling/shed
+    # did during the measured span (the adaptive controller's answer to
+    # backpressure — windows keep closing nonzero instead of stalling).
+    try:
+        ov = eng.overload_stats()
+        total_sampled = sum(w["events_sampled"] for w in windows)
+        total_shed: dict[str, int] = {}
+        for w in windows:
+            for k, v in w["events_shed"].items():
+                total_shed[k] = total_shed.get(k, 0) + v
+        log(
+            "e2e: overload diag "
+            f"state={ov['state']} pressure={ov['pressure']} "
+            f"sample_k={ov['sample_k']} shed={ov['shed']} "
+            f"transitions={ov['transitions']} "
+            f"window_states={[w['overload_state'] for w in windows]} "
+            f"events_sampled={total_sampled} "
+            f"events_shed={total_shed} "
+            f"accuracy_debt={m.accuracy_debt._value.get():.0f}"
+        )
+    except Exception:
+        pass
     lat.sort()
     p50 = lat[len(lat) // 2]
     p99 = lat[min(int(len(lat) * 0.99), len(lat) - 1)]
@@ -590,6 +650,22 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         "scrapes": len(lat),
         "duration_s": round(win["elapsed"], 1),
         "measure_windows": [round(w["rate"]) for w in windows],
+        # Per-window overload accounting (runtime/overload.py): the
+        # controller state the window closed under, its raw event
+        # count, and the events the 1-in-k sampler dropped (device
+        # HT-rescale re-synthesizes their weight — sampled+events
+        # accounts for the raw arrival gap under backpressure, and
+        # `events` must stay > 0 whenever the feed is live).
+        "window_overload": [
+            {
+                "state": w["overload_state"],
+                "sample_k": w["sample_k"],
+                "events": int(w["events"]),
+                "events_sampled": w["events_sampled"],
+                "events_shed": w["events_shed"],
+            }
+            for w in windows
+        ],
         # Windows zeroed by harness-transport outage episodes (see the
         # classification comment above); the headline median runs over
         # the non-stalled windows only.
@@ -632,7 +708,9 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
             min(8e9 / max(wire_bpe, 1e-9), host_path_rate)
         ),
     }
-    log(f"e2e: {rate / 1e6:.2f}M ev/s sustained, scrape p50 "
+    log(f"e2e: {rate / 1e6:.2f}M ev/s sustained "
+        f"({rate_unfiltered / 1e6:.2f}M unfiltered, "
+        f"{n_stalled} stalled windows), scrape p50 "
         f"{res['scrape_p50_ms']}ms p99 {res['scrape_p99_ms']}ms, "
         f"{wire_bpe:.1f} wire B/ev, link {link_mbs:.0f} MB/s")
     return res

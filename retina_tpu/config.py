@@ -222,6 +222,40 @@ class Config:
     # deployed agent.
     fault_spec: str = ""
 
+    # --- adaptive overload control (runtime/overload.py) ---
+    # NOMINAL -> SAMPLING -> SHEDDING -> DEGRADED driven by the max of
+    # the normalized pressure signals (worker staging fill, dispatch
+    # in-flight fill, handoff wait rate, harvest lag).
+    overload_enabled: bool = True
+    # Controller cadence; the feed loop calls tick() at least this often.
+    overload_tick_s: float = 0.1
+    # 1-in-k row sampling applied by feed workers in SAMPLING and above;
+    # the device step rescales surviving non-exempt rows by k so every
+    # packet-weighted estimate stays unbiased (Horvitz-Thompson).
+    overload_sample_k: int = 8
+    # Combined rows with at least this packet weight are heavy-hitter
+    # candidates: exempt from sampling on the host AND from rescaling on
+    # the device (the predicates must agree — both read F.PACKETS of
+    # the post-combine row). 0 exempts everything (sampling disabled).
+    overload_exempt_packets: int = 64
+    # Hysteresis thresholds on the [0, 1] pressure scale. Escalation is
+    # immediate at enter/shed/degrade; de-escalation needs pressure at
+    # or below exit continuously for dwell_s (one level per dwell).
+    overload_enter_pressure: float = 0.75
+    overload_exit_pressure: float = 0.45
+    overload_shed_pressure: float = 0.90
+    overload_degrade_pressure: float = 0.98
+    overload_dwell_s: float = 2.0
+    # In SHEDDING the shed set widens one stage per this many seconds
+    # of sustained at-or-above-shed pressure.
+    overload_shed_escalate_s: float = 1.0
+    # Enrichment shed order (cheapest-to-lose first); a prefix is shed
+    # before ANY raw event is dropped. Stages: dns (qname hashing),
+    # conntrack (accounting/GC scrape), labels (per-pod resolution).
+    overload_shed_order: list[str] = dataclasses.field(
+        default_factory=lambda: ["dns", "conntrack", "labels"]
+    )
+
     # --- pipeline shapes (jit keys; see models/pipeline.py) ---
     n_pods: int = 1 << 12
     cms_width: int = 1 << 15
@@ -270,10 +304,12 @@ class Config:
             # daemon arms it, so a parse-only dry run here is cheap.
             import re as _re
 
+            # Keep this pattern in sync with faults._ENTRY.
             for raw in self.fault_spec.split(","):
                 raw = raw.strip()
                 if raw and not _re.match(
-                    r"^[\w.\-]+:(raise|corrupt|hang(\d+(\.\d+)?)?)(@\d+)?$",
+                    r"^[\w.\-]+:(raise|corrupt|hang(\d+(\.\d+)?)?"
+                    r"|press(\d+(\.\d+)?)?)(@\d+)?$",
                     raw,
                 ):
                     raise ValueError(f"bad fault_spec entry {raw!r}")
@@ -282,6 +318,34 @@ class Config:
             v = getattr(self, f)
             if v <= 0 or (v & (v - 1)):
                 raise ValueError(f"{f} must be a positive power of two, got {v}")
+        if self.overload_sample_k < 1:
+            raise ValueError(
+                f"overload_sample_k must be >= 1, "
+                f"got {self.overload_sample_k}"
+            )
+        if self.overload_exempt_packets < 0:
+            raise ValueError(
+                f"overload_exempt_packets must be >= 0, "
+                f"got {self.overload_exempt_packets}"
+            )
+        thresholds = (
+            self.overload_exit_pressure, self.overload_enter_pressure,
+            self.overload_shed_pressure, self.overload_degrade_pressure,
+        )
+        if not all(0.0 < t <= 1.0 for t in thresholds) or any(
+            a >= b for a, b in zip(thresholds, thresholds[1:])
+        ):
+            raise ValueError(
+                "overload thresholds must satisfy 0 < exit < enter < "
+                f"shed < degrade <= 1, got {thresholds}"
+            )
+        for f in ("overload_tick_s", "overload_dwell_s",
+                  "overload_shed_escalate_s"):
+            if getattr(self, f) <= 0:
+                raise ValueError(f"{f} must be > 0, got {getattr(self, f)}")
+        from retina_tpu.runtime.overload import validate_shed_order
+
+        validate_shed_order(self.overload_shed_order)
 
 
 _BOOL_TRUE = {"1", "true", "yes", "on"}

@@ -38,7 +38,9 @@ from retina_tpu.metrics import get_metrics
 from retina_tpu.models.identity import HostIdentityTable, IdentityMap
 from retina_tpu.models.pipeline import PipelineConfig
 from retina_tpu.parallel.combine import combine_blocks
-from retina_tpu.parallel.feed import FeedWorkerPool
+from retina_tpu.parallel.feed import (
+    FeedWorkerPool, TransferMux, TransferQueue,
+)
 from retina_tpu.parallel.flowdict import flow_dict_stats, make_flow_dict
 from retina_tpu.parallel.partition import (
     ShardedBatch, _next_bucket, partition_events,
@@ -46,6 +48,7 @@ from retina_tpu.parallel.partition import (
 from retina_tpu.parallel.telemetry import ShardedTelemetry, topk_from_snapshot
 from retina_tpu.plugins.api import QueueSink
 from retina_tpu.runtime import faults
+from retina_tpu.runtime.overload import OverloadController
 from retina_tpu.runtime.supervisor import (
     Heartbeat, Supervisor, policy_from_config,
 )
@@ -197,7 +200,11 @@ class SketchEngine:
         self._ident_host = HostIdentityTable(n_slots=cfg.identity_slots)
         self._ident_dict: dict[int, int] = {}
 
-        self._observers: list[Callable[[np.ndarray, str], None]] = []
+        # (fn, name) pairs: the name lets the overload controller shed
+        # a specific enrichment observer (e.g. "dns") by stage.
+        self._observers: list[
+            tuple[Callable[[np.ndarray, str], None], str]
+        ] = []
         # bucket size -> jitted pad-to-capacity kernel (device-side zero
         # extension of a small transfer to the step's static shape).
         self._pad_cache: dict[int, Any] = {}
@@ -217,7 +224,7 @@ class SketchEngine:
         # so an in-flight active window can never publish AFTER the
         # idle zeroing and latch a stale anomaly flag), or None to
         # shut the thread down.
-        self._harvest_q: queue_mod.Queue = queue_mod.Queue()
+        self._harvest_q: queue_mod.Queue = queue_mod.Queue()  # noqa: RT102 — window-cadence items, see above
         self._harvest_thread: threading.Thread | None = None
         # Set by the shutdown path after the final drain: a straggler
         # (e.g. a warm_close racing stop) must not resurrect the
@@ -243,6 +250,31 @@ class SketchEngine:
         # Sharded multi-worker feed pool (parallel/feed.py), created by
         # start() when feed_workers resolves to > 1.
         self._feed_pool: Any = None
+        # Adaptive overload control (runtime/overload.py): the feed
+        # loop ticks the controller against the engine's pressure
+        # signals; feed workers sample through it, plugins consult
+        # shed_active before enrichment work.
+        self._overload = OverloadController(cfg, self._overload_signals)
+        # Protected close lane: window ticks acquire THIS semaphore,
+        # never the step in-flight one — a saturated step pipeline can
+        # delay a close behind queued transfers but can never starve it
+        # of a submission slot (a window is always eventually closed).
+        # Two slots: one close may still be in flight on a slow link
+        # when the next tick lands.
+        self._close_inflight = threading.Semaphore(2)
+        # Device-resident sample-k scalars, cached per k (same
+        # rationale as _device_consts; cleared on recovery rebuild).
+        self._sampk_dev: dict[int, Any] = {}
+        # Overload signal bookkeeping: handoff-wait rate window and the
+        # dispatch-latency EWMA (seconds, updated on the proxy thread
+        # where device_step_seconds is observed).
+        self._ov_wait_prev = 0.0
+        self._ov_wait_t = time.monotonic()
+        self._dispatch_lat_ewma = 0.0
+        # Timestamp of the last EWMA sample: a stale measurement means
+        # the pipeline is idle, not slow, and must not read as
+        # pressure (an idle engine would otherwise never de-escalate).
+        self._dispatch_lat_t = 0.0
         self.last_window: dict[str, np.ndarray] = {}
         self._state_lock = threading.Lock()
         self.started = threading.Event()
@@ -409,6 +441,7 @@ class SketchEngine:
             self._zero_u32 = None
             self._api_val = -1
             self._desc_table = None
+            self._sampk_dev = {}
             if self._flow_dict is not None:
                 with self._fd_lock:
                     self._flow_dict.clear()
@@ -542,10 +575,15 @@ class SketchEngine:
     def set_apiserver_ips(self, ips: list[int]) -> None:
         self.apiserver_ip = ips[0] if ips else 0
 
-    def add_observer(self, fn: Callable[[np.ndarray, str], None]) -> None:
+    def add_observer(
+        self, fn: Callable[[np.ndarray, str], None], name: str = ""
+    ) -> None:
         """Observers see every accepted record block on the feed thread
-        (dns tally, flow export...). Must be fast and never raise."""
-        self._observers.append(fn)
+        (dns tally, flow export...). Must be fast and never raise.
+        ``name`` ties an observer to an overload shed stage: while that
+        stage is shed (runtime/overload.py) the observer is skipped and
+        the skipped events are counted under events_shed{stage}."""
+        self._observers.append((fn, name))
 
     def _device_consts(self):
         """(proxy thread) Lazily place the replicated scalar constants
@@ -566,6 +604,17 @@ class SketchEngine:
                 np.uint32(api & 0xFFFFFFFF), self._replicated
             )
             self._api_val = api
+
+    def _sampk(self, k: int):
+        """(proxy thread) Device-resident sample-k scalar, cached per
+        distinct k (in practice: 1 and overload_sample_k). Same
+        rationale as _device_consts — a Python-scalar jit argument
+        costs a host->device commit per call."""
+        dev = self._sampk_dev.get(k)
+        if dev is None:
+            dev = jax.device_put(np.uint32(k), self._replicated)
+            self._sampk_dev[k] = dev
+        return dev
 
     # -- lifecycle ----------------------------------------------------
     def compile(self) -> None:
@@ -650,6 +699,8 @@ class SketchEngine:
         publishes instead of vanishing — the only side effect is that
         the first entropy window is shorter than window_seconds."""
         ingested = self._events_in
+        meta = self._overload.window_annotation()
+        meta["events"] = ingested - self._closed_events_in
         with self._state_lock:
             self.state, win = self.sharded.end_window(
                 self.state, self._zthresh
@@ -657,7 +708,7 @@ class SketchEngine:
         stacked = self._win_stack(win)
         self._closed_events_in = ingested
         self._ensure_harvest_thread()
-        self._harvest_q.put(("win", stacked))
+        self._harvest_q.put(("win", stacked, meta))
         get_metrics().windows_closed.inc()
 
     def _warm_snap_job(self) -> None:
@@ -1234,6 +1285,7 @@ class SketchEngine:
         meta_known[5:] = nv_known
         n_events = int(sb.events)
         n_valid_total = int(nv_new.sum() + nv_known.sum())
+        samp_k = int(sb.sample_k)
 
         def xfer_and_step():
             faults.inject("transfer")
@@ -1327,13 +1379,21 @@ class SketchEngine:
                             # the FIRST side that runs counts host
                             # losses once whichever sides are present.
                             lost=lost_dev if first else self._zero_u32,
+                            sample_k=self._sampk(samp_k),
                         )
                         first = False
                         n_steps += 1
                 self.state = st
             if record_metrics:
+                t_end = time.perf_counter()
                 m.transfer_seconds.observe(t0 - t_x0)
-                m.device_step_seconds.observe(time.perf_counter() - t0)
+                m.device_step_seconds.observe(t_end - t0)
+                # Overload signal: EWMA of transfer+step wall time
+                # (proxy thread only — no lock needed).
+                self._dispatch_lat_ewma = (
+                    0.8 * self._dispatch_lat_ewma + 0.2 * (t_end - t_x0)
+                )
+                self._dispatch_lat_t = time.monotonic()
                 m.device_batch_fill.set(
                     n_valid_total
                     / max(D * self.cfg.batch_capacity * n_steps, 1)
@@ -1465,6 +1525,7 @@ class SketchEngine:
         meta[5:] = sb.n_valid
         n_valid_total = int(sb.n_valid.sum())
         n_events = int(sb.events)
+        samp_k = int(sb.sample_k)
 
         def xfer_and_step():
             faults.inject("transfer")
@@ -1493,6 +1554,7 @@ class SketchEngine:
                         # Host-partition losses are folded into the
                         # device totals exactly once per flush.
                         lost=lost_dev if w == 0 else self._zero_u32,
+                        sample_k=self._sampk(samp_k),
                     )
                 self.state = st
             if record_metrics:
@@ -1500,8 +1562,15 @@ class SketchEngine:
                 # one-shot 30-100s cold-compile sample would inflate
                 # the histogram p99/max forever and seed transfer_bytes
                 # with a synthetic zero batch.
+                t_end = time.perf_counter()
                 m.transfer_seconds.observe(t0 - t_x0)
-                m.device_step_seconds.observe(time.perf_counter() - t0)
+                m.device_step_seconds.observe(t_end - t0)
+                # Overload signal: EWMA of transfer+step wall time
+                # (proxy thread only — no lock needed).
+                self._dispatch_lat_ewma = (
+                    0.8 * self._dispatch_lat_ewma + 0.2 * (t_end - t_x0)
+                )
+                self._dispatch_lat_t = time.monotonic()
                 # Fill of the step capacity actually dispatched
                 # (windows x batch_capacity): identical to the
                 # historical series for single-window batches, and
@@ -1562,7 +1631,18 @@ class SketchEngine:
             pass
         return stacked
 
-    def _publish_window(self, win_host: dict[str, np.ndarray]) -> None:
+    def _publish_window(
+        self,
+        win_host: dict[str, np.ndarray],
+        meta: dict | None = None,
+    ) -> None:
+        # ``meta`` is the overload annotation captured AT CLOSE TIME
+        # (overload state, sampled_fraction, shed stages, raw events in
+        # the window): a window closed under sampling says so forever,
+        # however late its readback publishes.
+        if meta is not None:
+            win_host = dict(win_host)
+            win_host["overload"] = meta
         self.last_window = win_host
         m = get_metrics()
         dims = ["src_ip", "dst_ip", "dst_port"]
@@ -1649,13 +1729,13 @@ class SketchEngine:
             try:
                 if item is None:
                     return
-                kind, stacked = item
+                kind, stacked, meta = item
                 faults.inject("harvest")
                 if kind == "zero":
                     z = np.zeros((3,), np.float32)
                     self._publish_window({
                         "entropy_bits": z, "anomaly": z, "zscore": z,
-                    })
+                    }, meta)
                 else:
                     # fetch_on_device, NOT a direct device_get: every
                     # JAX call must ride the proxy thread (tunnel
@@ -1666,7 +1746,7 @@ class SketchEngine:
                         "entropy_bits": host[0],
                         "anomaly": host[1],
                         "zscore": host[2],
-                    })
+                    }, meta)
             except Exception:
                 if self._count_error("harvest_readback"):
                     self.log.exception("window readback failed")
@@ -1743,10 +1823,19 @@ class SketchEngine:
             # readback publishing after a direct zeroing would re-latch
             # the stale flag — FIFO through one queue keeps publish
             # order = close order.
+            meta = self._overload.window_annotation()
+            meta["events"] = 0  # idle, not stalled: nothing arrived
             self._ensure_harvest_thread()
-            self._harvest_q.put(("zero", None))
+            self._harvest_q.put(("zero", None, meta))
             return
         ingested = self._events_in
+        # Annotation snapshot BEFORE _closed_events_in advances: the
+        # raw-event count this window actually ingested, plus the
+        # controller's per-window sampling accounting. A window closed
+        # while sampling is NEVER reported as empty — its event count
+        # and sampled_fraction say exactly what was kept.
+        meta = self._overload.window_annotation()
+        meta["events"] = ingested - self._closed_events_in
 
         def close():
             self._device_consts()
@@ -1762,12 +1851,18 @@ class SketchEngine:
         # forever.
         self._closed_events_in = ingested
         self._ensure_harvest_thread()
-        self._harvest_q.put(("win", stacked))
+        self._harvest_q.put(("win", stacked, meta))
         get_metrics().windows_closed.inc()
 
     def _submit_close_window(self) -> None:
-        """Fire-and-forget window close, bounded like step submissions
-        and FIFO-ordered after them on the proxy queue."""
+        """Fire-and-forget window close on the PROTECTED close lane:
+        FIFO-ordered after step submissions on the proxy queue, but
+        bounded by its own semaphore — a step pipeline that has eaten
+        every in-flight slot can never starve a window tick of a
+        submission slot (overload contract: a window is always closed,
+        possibly annotated, never silently skipped). Non-blocking: when
+        both close slots are in flight behind a stalled link, the tick
+        defers (counted) and the next tick closes a longer window."""
 
         def safe_close():
             try:
@@ -1778,9 +1873,11 @@ class SketchEngine:
                 if self._fatal_device_error(e):
                     self._request_recovery(repr(e))
             finally:
-                self._inflight.release()
+                self._close_inflight.release()
 
-        self._inflight.acquire()
+        if not self._close_inflight.acquire(blocking=False):
+            get_metrics().windows_deferred.inc()
+            return
         submit_on_device(safe_close)
 
     def _resolve_feed_workers(self) -> int:
@@ -1799,6 +1896,76 @@ class SketchEngine:
         gating (same signal the inline feed loop reads)."""
         with self._busy_lock:
             return self._inflight_busy
+
+    # -- adaptive overload control (runtime/overload.py) --------------
+    def _overload_signals(self) -> dict[str, float]:
+        """Normalized [0,1] pressure signals for the overload
+        controller — the max across them is the pipeline pressure.
+        Called from the feed loop at tick cadence; every read here is
+        lock-free or a single counter load."""
+        sig: dict[str, float] = {}
+        pool = self._feed_pool
+        now = time.monotonic()
+        if pool is not None:
+            # Worst per-worker staging fill: the first queue to
+            # overflow decides when blocks start dropping.
+            sig["staging"] = pool.max_staging_fill()
+            # Handoff wait RATE (seconds waited per second): workers
+            # blocked on a full transfer queue mean the device side
+            # can't keep up even though staging still has room.
+            wait = pool.handoff_wait_total()
+            dt = max(now - self._ov_wait_t, 1e-6)
+            sig["handoff_wait"] = min(
+                1.0, max(0.0, wait - self._ov_wait_prev) / dt
+            )
+            self._ov_wait_prev = wait
+            self._ov_wait_t = now
+        depth = max(1, self.cfg.feed_pipeline_depth)
+        sig["inflight"] = min(1.0, self._busy_count() / depth)
+        # Harvest lag: closed windows whose readback hasn't landed.
+        sig["harvest"] = min(
+            1.0, self._harvest_q.unfinished_tasks / 4.0
+        )
+        # Dispatch latency EWMA against the window budget: device
+        # steps eating a whole window interval starve the close lane.
+        # A stale sample (no dispatch for >2 windows) means idle, not
+        # slow — without the age gate the frozen EWMA would hold the
+        # controller above the exit threshold forever.
+        if now - self._dispatch_lat_t <= 2.0 * self.cfg.window_seconds:
+            sig["dispatch_lat"] = min(
+                1.0,
+                self._dispatch_lat_ewma
+                / max(0.5 * self.cfg.window_seconds, 1e-3),
+            )
+        # Chaos/bench injection (runtime/faults.py feed.backpressure):
+        # a sustained synthetic pressure signal so tests drive the
+        # NOMINAL -> SAMPLING -> SHEDDING arc without having to
+        # actually saturate the host. 0.95 sits between the shed (0.90)
+        # and degrade (0.98) thresholds: DEGRADED stays reserved for
+        # real saturation / crash-only recovery.
+        if faults.pressure("feed.backpressure"):
+            sig["fault"] = 0.95
+        # Crash-only recovery pins the controller at DEGRADED for the
+        # duration (drop-and-count is the ultimate shed).
+        if self._degraded.is_set():
+            sig["degraded"] = 1.0
+        return sig
+
+    @property
+    def overload(self) -> OverloadController:
+        """The controller itself (plugins/modules call note_shed on
+        it; tests drive tick with injected clocks)."""
+        return self._overload
+
+    def shed_active(self, stage: str) -> bool:
+        """Plugins/modules consult this before enrichment work (dns
+        qname hashing, conntrack scrape, label resolution)."""
+        return self._overload.shed_active(stage)
+
+    def overload_stats(self) -> dict[str, Any]:
+        """Controller state for the control server's debug var and the
+        bench diag."""
+        return self._overload.stats()
 
     def _build_quantum(
         self, blocks: list[np.ndarray], n_raw: int, now_s: int
@@ -1821,6 +1988,13 @@ class SketchEngine:
             all_rec = blocks[0]
         else:
             all_rec = np.concatenate(blocks, axis=0)
+        # Overload sampling sits POST-combine / PRE-partition: a row's
+        # packet weight is final here, so the device step can recompute
+        # the same exemption predicate over the same rows and rescale
+        # the non-exempt survivors by k (Horvitz-Thompson — see
+        # runtime/overload.py). k rides the ShardedBatch to the
+        # dispatch paths.
+        all_rec, samp_k = self._overload.sample_rows(all_rec)
         items: list[tuple] = []
         for off in range(0, len(all_rec), coal):
             chunk = all_rec[off : off + coal]
@@ -1828,6 +2002,7 @@ class SketchEngine:
                 chunk, self.n_devices, coal_per_dev,
                 min_bucket=self.cfg.transfer_min_bucket,
             )
+            sb.sample_k = samp_k
             # raw-row accounting goes to the chunk that carries it;
             # chunk boundaries are an implementation detail
             items.append(("step", sb, now_s, n_raw if off == 0 else 0))
@@ -1844,6 +2019,7 @@ class SketchEngine:
         else:
             st = {"workers": 0, "mode": "inline", "per_worker": []}
         st["flow_dict"] = flow_dict_stats(self._flow_dict)
+        st["overload"] = self._overload.stats()
         return st
 
     def _dispatch_loop(self, q) -> None:
@@ -1895,14 +2071,10 @@ class SketchEngine:
         drops and counts — never the producers)."""
         self.started.set()
         cap = self.cfg.batch_capacity * self.n_devices
-        # A flush quantum may combine down to more than one device batch;
-        # up to feed_coalesce_windows batches ride ONE transfer (sliced
-        # into step windows on device) — one link round-trip per flush,
-        # not one per batch.
-        coal = cap * max(1, self.cfg.feed_coalesce_windows)
         # Flush threshold: accumulating beyond one device batch raises the
         # combine ratio (more duplicate descriptors per pass); the
-        # interval timeout still bounds latency.
+        # interval timeout still bounds latency. Coalescing into device
+        # batches happens inside _build_quantum.
         quantum = max(cap, self.cfg.flush_max_events)
         depth = self.cfg.feed_pipeline_depth
         # Sharded multi-worker feed (parallel/feed.py): with more than
@@ -1918,8 +2090,16 @@ class SketchEngine:
         q: Any = None
         worker: threading.Thread | None = None
         pool: FeedWorkerPool | None = None
+        inline_tq: TransferQueue | None = None
         if depth > 0 and n_workers <= 1:
-            q = queue_mod.Queue(maxsize=depth)
+            # Inline mode rides the same mux shape as the pool: step
+            # items through one bounded TransferQueue, window ticks
+            # through the control lane — the protected-lane contract
+            # (window closes stay on cadence even under a step
+            # backlog) holds in BOTH feed modes.
+            inline_data = threading.Event()
+            inline_tq = TransferQueue(depth, inline_data)
+            q = TransferMux([inline_tq], inline_data)
 
         def drop_item(item):
             """Dead-worker path: account the loss, never enqueue into a
@@ -1936,28 +2116,25 @@ class SketchEngine:
                 ).inc(int(item[1].events) + int(item[1].lost))
 
         def submit(item):
-            if pool is not None:
-                # Pool mode: only window/control items come through
-                # here (workers hand step items off directly).
-                if worker is None or not worker.is_alive():
-                    drop_item(item)
-                else:
-                    q.put_ctl(item)
-            elif q is not None:
-                # Block only while the worker lives: if it died (fatal
-                # runtime error escaping its catch), drop + count rather
-                # than wedging the feed loop on a full queue forever —
-                # and check liveness BEFORE enqueueing, or items that
-                # still fit in the queue would vanish uncounted.
-                while True:
-                    if not worker.is_alive():
+            if q is not None:
+                if item[0] != "step":
+                    # Window/control items (both feed modes) ride the
+                    # mux control lane: closes overtake the step
+                    # backlog and stay on cadence under overload.
+                    if worker is None or not worker.is_alive():
                         drop_item(item)
-                        return
-                    try:
-                        q.put(item, timeout=1.0)
-                        return
-                    except queue_mod.Full:  # noqa: RT101 — liveness re-check loop
-                        pass
+                    else:
+                        q.put_ctl(item)
+                else:
+                    # Inline mode only (pool workers hand step items
+                    # off directly). Block only while the worker
+                    # lives: if it died (fatal runtime error escaping
+                    # its catch), drop + count rather than wedging the
+                    # feed loop on a full queue forever.
+                    if not inline_tq.put(
+                        item, alive=lambda: worker.is_alive()
+                    ):
+                        drop_item(item)
             elif item[0] == "step":
                 self._dispatch_sharded(item[1], item[2], item[3])
             else:
@@ -2002,10 +2179,6 @@ class SketchEngine:
             if pool is not None:
                 pool.start()
 
-        coal_per_dev = self.cfg.batch_capacity * max(
-            1, self.cfg.feed_coalesce_windows
-        )
-
         m = get_metrics()
         pending: list[np.ndarray] = []
         n_pending = 0
@@ -2013,7 +2186,7 @@ class SketchEngine:
         next_window = time.monotonic() + self.cfg.window_seconds
 
         feed_trace = self._feed_trace
-        trace_acc = {"accum": 0.0, "combine": 0.0, "part": 0.0,
+        trace_acc = {"accum": 0.0, "build": 0.0,
                      "submit": 0.0, "n": 0, "ev": 0}
         t_flush_end = time.monotonic()
 
@@ -2021,62 +2194,55 @@ class SketchEngine:
             nonlocal pending, n_pending, last_flush, t_flush_end
             t0 = time.monotonic()
             n_raw = n_pending
-            if self.cfg.host_combine:
-                # Multi-block combine: the quantum's block list feeds
-                # the native combiner directly — no concat copy
-                # (parallel/combine.combine_blocks).
-                all_rec = combine_blocks(pending)
-                m.combine_ratio.set(n_raw / max(len(all_rec), 1))
-            elif len(pending) == 1:
-                all_rec = pending[0]  # skip the concat copy
-            else:
-                all_rec = np.concatenate(pending, axis=0)
+            blocks = pending
             pending = []
             n_pending = 0
             last_flush = time.monotonic()
-            t1 = last_flush
-            now_s = int(time.time())
-            t2 = t1
-            for off in range(0, len(all_rec), coal):
-                chunk = all_rec[off : off + coal]
-                sb = partition_events(
-                    chunk, self.n_devices, coal_per_dev,
-                    min_bucket=self.cfg.transfer_min_bucket,
-                )
-                t2 = time.monotonic()
-                # raw-row accounting goes to the chunk that carries it;
-                # chunk boundaries are an implementation detail
-                submit(("step", sb, now_s, n_raw if off == 0 else 0))
+            # Shared combine+sample+partition path (_build_quantum) —
+            # the SAME code the feed workers run, so overload sampling
+            # applies identically in inline mode.
+            items = self._build_quantum(blocks, n_raw, int(time.time()))
+            t1 = time.monotonic()
+            for item in items:
+                submit(item)
             if feed_trace:
                 t3 = time.monotonic()
                 trace_acc["accum"] += t0 - t_flush_end
-                trace_acc["combine"] += t1 - t0
-                trace_acc["part"] += t2 - t1
-                trace_acc["submit"] += t3 - t2
+                trace_acc["build"] += t1 - t0
+                trace_acc["submit"] += t3 - t1
                 trace_acc["n"] += 1
                 trace_acc["ev"] += n_raw
                 t_flush_end = t3
                 if trace_acc["n"] % 8 == 0:
                     per = {k: trace_acc[k] / trace_acc["n"]
-                           for k in ("accum", "combine", "part",
-                                     "submit")}
+                           for k in ("accum", "build", "submit")}
                     self.log.info(
                         "feed trace: %d flushes, %.2fM ev/flush, "
-                        "accum %.0fms combine %.0fms part %.0fms "
-                        "submit %.0fms",
+                        "accum %.0fms build %.0fms submit %.0fms",
                         trace_acc["n"],
                         trace_acc["ev"] / trace_acc["n"] / 1e6,
-                        per["accum"] * 1e3, per["combine"] * 1e3,
-                        per["part"] * 1e3, per["submit"] * 1e3,
+                        per["accum"] * 1e3, per["build"] * 1e3,
+                        per["submit"] * 1e3,
                     )
 
         hb_feed = self._register_hb("engine-feed")
         try:
             while not stop.is_set():
                 hb_feed.beat()
+                # Overload controller tick: cheap no-op inside
+                # overload_tick_s; transitions happen here, on the one
+                # thread that sees every block.
+                self._overload.tick()
                 blocks = self.sink.drain(max_blocks=64)
+                shed_dns = self._overload.shed_active("dns")
                 for rec, plugin in blocks:
-                    for obs in self._observers:
+                    for obs, oname in self._observers:
+                        if shed_dns and oname == "dns":
+                            # SHEDDING: dns qname hashing is the first
+                            # enrichment stage dropped — raw events
+                            # still reach the device untouched.
+                            self._overload.note_shed("dns", len(rec))
+                            continue
                         try:
                             obs(rec, plugin)
                         except Exception:
@@ -2137,12 +2303,11 @@ class SketchEngine:
                 q.put_ctl(None)
                 worker.join(timeout=30.0)
             elif q is not None:
-                try:
-                    # Bounded: a wedged worker with a full queue must not
-                    # hang shutdown before the join timeout gets its say.
-                    q.put(None, timeout=30.0)
-                except queue_mod.Full:
-                    self.log.error("dispatch queue stuck at shutdown")
+                # Mux sentinel: delivered only after the step queue
+                # drains (same contract as pool mode), and put_ctl
+                # never blocks — the join timeout bounds a wedged
+                # worker.
+                q.put_ctl(None)
                 worker.join(timeout=30.0)
             # Drain fire-and-forget submissions (FIFO fence) so the
             # state a follow-up checkpoint saves includes every batch

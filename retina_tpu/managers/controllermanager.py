@@ -123,6 +123,9 @@ class ControllerManager:
         # Sharded-feed backpressure: per-worker fill / staged backlog /
         # handoff wait + drop counters (engine.feed_stats).
         self.server.expose_var("feed", self.engine.feed_stats)
+        # Adaptive overload control: state/pressure/signals/shed set
+        # (runtime/overload.py; docs/operations.md §6).
+        self.server.expose_var("overload", self.engine.overload_stats)
         self.server.expose_var("top_flows", self._top_flows)
         self.server.expose_var("top_services", self._top_services)
         self.server.expose_var("top_dns", self._top_dns)

@@ -75,8 +75,11 @@ class PluginManager:
                 ct.attach_engine(engine)
             dns = self.plugins.get("dns")
             if dns is not None and hasattr(dns, "observe_records"):
+                # Named "dns": the overload controller sheds this
+                # observer first under SHEDDING (runtime/overload.py).
                 engine.add_observer(
-                    lambda rec, plugin: dns.observe_records(rec)
+                    lambda rec, plugin: dns.observe_records(rec),
+                    name="dns",
                 )
 
     # -- reconcile (pluginmanager.go:91-113) ---------------------------

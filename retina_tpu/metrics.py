@@ -122,6 +122,12 @@ class Metrics:
             [],
             buckets=[0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 60.0, 120.0],
         )
+        # Adaptive overload control (runtime/overload.py; see
+        # metric_names for semantics).
+        self.overload_state = g(mn.OVERLOAD_STATE, [])
+        self.events_sampled = c(mn.EVENTS_SAMPLED, [])
+        self.events_shed = c(mn.EVENTS_SHED, [mn.L_STAGE])
+        self.accuracy_debt = c(mn.ACCURACY_DEBT, [])
         # Device->host bytes (snapshot readbacks): on a serialized
         # tunnel link they share the same pipe as transfer_bytes, so
         # link-utilization math must sum both directions.

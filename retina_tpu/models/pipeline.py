@@ -94,6 +94,13 @@ class PipelineConfig:
     # an entry in the explicit filter map are masked out of every
     # aggregator. bypass_filter=True admits everything.
     bypass_filter: bool = True
+    # Overload-sampling exemption threshold (runtime/overload.py): a
+    # combined row whose packet weight is >= this is a heavy-hitter
+    # candidate — never sampled on the host and never rescaled here.
+    # MUST match the host sampler's predicate (both read F.PACKETS of
+    # the post-combine row; partition/wire transport preserve it).
+    # 0 exempts every row, i.e. sampling rescale disabled.
+    sample_exempt_packets: int = 64
     # Whether resolving to a pod identity alone makes an event
     # interesting. True matches the default deployment (the metrics
     # module tracks every pod, so the filter map holds every pod IP
@@ -223,6 +230,7 @@ class TelemetryPipeline:
         ident: IdentityMap,
         apiserver_ip: jnp.ndarray,  # scalar uint32 (0 = disabled)
         filter_map: IdentityMap | None = None,  # explicit IPs of interest
+        sample_k=np.uint32(1),  # overload 1-in-k factor (scalar uint32)
     ) -> tuple[PipelineState, dict[str, jnp.ndarray]]:
         """Process one batch. Pure; jit via TelemetryPipeline.jitted_step."""
         c = self.config
@@ -236,6 +244,32 @@ class TelemetryPipeline:
         tcp_flags = (meta >> 16) & np.uint32(0xFF)
         direction = (meta >> 4) & np.uint32(0xF)
         bytes_, packets = col(F.BYTES), col(F.PACKETS)
+
+        # ---- overload-sampling rescale (Horvitz-Thompson) ----
+        # When the host fed a 1-in-k sampled batch (ShardedBatch.
+        # sample_k > 1, runtime/overload.py), re-weight the surviving
+        # NON-exempt rows by k so every packet-weighted estimate below
+        # (sketches, rectangles, totals, conntrack accumulators) stays
+        # unbiased. The exemption predicate is recomputed here over the
+        # same post-combine rows the host sampler saw: heavy-hitter
+        # candidates (packet weight >= sample_exempt_packets) and
+        # apiserver latency probes (TSVAL/TSECR lanes) were kept
+        # unsampled and must not be rescaled. u32 saturating multiply —
+        # a clamped row is already a massive heavy hitter.
+        k = jnp.asarray(sample_k, jnp.uint32)
+        if c.sample_exempt_packets > 0:
+            exempt = (
+                packets >= np.uint32(c.sample_exempt_packets)
+            ) | ((col(F.TSVAL) | col(F.TSECR)) != 0)
+            scale = jnp.where((k > 1) & ~exempt, k, np.uint32(1))
+            lim = np.uint32(0xFFFFFFFF) // jnp.maximum(k, np.uint32(1))
+            cap = np.uint32(0xFFFFFFFF)
+            packets = jnp.where(
+                (scale > 1) & (packets > lim), cap, packets * scale
+            )
+            bytes_ = jnp.where(
+                (scale > 1) & (bytes_ > lim), cap, bytes_ * scale
+            )
         verdict = col(F.VERDICT)
         reason = jnp.minimum(col(F.DROP_REASON), np.uint32(c.n_drop_reasons - 1))
         ev_type = col(F.EVENT_TYPE)

@@ -150,8 +150,19 @@ class MetricsModule:
         if not metrics:
             return
         snap = self.engine.snapshot()
+        shed = getattr(self.engine, "shed_active", None)
+        labeler: dict = {}
+        if shed is not None and shed("labels"):
+            # Overload SHEDDING (runtime/overload.py): per-pod label
+            # resolution is the last enrichment stage dropped — pod
+            # series publish with index placeholders this pass instead
+            # of walking the endpoint cache under saturation. Counted
+            # per skipped pass.
+            self.engine.overload.note_shed("labels")
+        else:
+            labeler = self.cache.index_label_map()
         ctx = PublishCtx(
-            labeler=self.cache.index_label_map(),
+            labeler=labeler,
             namespaces=spec.namespaces,
             remote_context=self.cfg.remote_context,
             dns_resolver=self.dns_resolver,

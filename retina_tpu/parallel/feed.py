@@ -403,6 +403,23 @@ class FeedWorkerPool:
             if w.is_alive():
                 _log.error("feed worker %d did not stop in time", w.idx)
 
+    # -- pressure signals (overload controller, runtime/overload.py) ---
+    def max_staging_fill(self) -> float:
+        """Worst per-worker staging occupancy in [0, 1] — the leading
+        saturation signal: 1.0 means the NEXT block dealt to that shard
+        is one skip away from a raw handoff drop."""
+        if not self.workers:
+            return 0.0
+        return max(
+            w.pending_blocks() / self.staging_blocks for w in self.workers
+        )
+
+    def handoff_wait_total(self) -> float:
+        """Cumulative producer seconds spent waiting on a full transfer
+        slot, summed over workers; the controller turns the delta into
+        a wait rate (seconds waited per wall second)."""
+        return sum(w.outq.wait_s for w in self.workers)
+
     def stats(self) -> dict[str, Any]:
         return {
             "workers": len(self.workers),

@@ -71,6 +71,10 @@ class ShardedBatch:
     events: int = 0  # EVENTS the kept rows stand for (same packet
     # weighting as ``lost``) — what to count if this batch is dropped
     # downstream instead of reaching the device
+    sample_k: int = 1  # overload 1-in-k applied before partitioning
+    # (runtime/overload.py): the device step rescales non-exempt rows
+    # by this factor so packet-weighted estimates stay unbiased; 1 =
+    # unsampled
 
 
 def _next_bucket(n: int) -> int:

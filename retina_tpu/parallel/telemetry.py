@@ -84,12 +84,13 @@ class ShardedTelemetry:
     # ------------------------------------------------------------------
     def _build_step(self):
         def local_step(
-            state, records, n_valid, now_s, ident, apiserver_ip, filt, lost
+            state, records, n_valid, now_s, ident, apiserver_ip, filt, lost,
+            sample_k,
         ):
             s = jax.tree.map(lambda x: x[0], state)
             new, summary = self.pipeline.step(
                 s, records[0], n_valid[0], now_s, ident, apiserver_ip,
-                filter_map=filt,
+                filter_map=filt, sample_k=sample_k,
             )
             # Host-side partition overflow losses land in totals[7] ("lost")
             # on one device only, so the snapshot psum counts them once —
@@ -114,7 +115,7 @@ class ShardedTelemetry:
         fn = _shard_map(
             local_step,
             mesh=self.mesh,
-            in_specs=(sh, sh, sh, P(), P(), P(), P(), P()),
+            in_specs=(sh, sh, sh, P(), P(), P(), P(), P(), P()),
             out_specs=(
                 sh,
                 {
@@ -138,6 +139,7 @@ class ShardedTelemetry:
         apiserver_ip=0,
         filter_map: IdentityMap | None = None,  # explicit IPs of interest
         lost=0,  # host-side partition overflow count (ShardedBatch.lost)
+        sample_k=1,  # overload 1-in-k factor (ShardedBatch.sample_k)
     ) -> tuple[PipelineState, dict[str, jnp.ndarray]]:
         if self._step is None:
             self._step = self._build_step()
@@ -161,6 +163,14 @@ class ShardedTelemetry:
             jnp.asarray(
                 int(lost) & 0xFFFFFFFF
                 if isinstance(lost, (int, np.integer)) else lost,
+                jnp.uint32,
+            ),
+            # Same pass-through rule as ``lost``: the engine hands a
+            # device-resident scalar from its per-k cache on the hot
+            # path; host ints only show up in tests/direct callers.
+            jnp.asarray(
+                int(sample_k) & 0xFFFFFFFF
+                if isinstance(sample_k, (int, np.integer)) else sample_k,
                 jnp.uint32,
             ),
         )

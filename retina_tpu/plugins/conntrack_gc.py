@@ -39,6 +39,15 @@ class ConntrackPlugin(Plugin):
     def gc_once(self) -> dict[str, int]:
         if self.engine is None:
             return {}
+        shed = getattr(self.engine, "shed_active", None)
+        if shed is not None and shed("conntrack"):
+            # Overload SHEDDING (runtime/overload.py): skip the GC +
+            # gauge scrape pass — one fewer device round-trip per
+            # cadence while the pipeline is saturated. The device
+            # table keeps updating inline; entries just age until the
+            # shed clears. Counted per skipped pass.
+            self.engine.overload.note_shed("conntrack")
+            return {}
         stats = self.engine.conntrack_gc()
         if stats:
             m = get_metrics()

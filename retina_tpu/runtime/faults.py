@@ -7,10 +7,14 @@ a comma-separated spec; each entry is ``site:action[@N]``:
     harvest:hang@1              hang the harvest thread on its 1st item
     plugin.packetparser:raise@1 crash the plugin's 1st start attempt
     checkpoint:corrupt@1        torn-write the next checkpoint save
+    feed.backpressure:press     synthetic queue saturation (sustained)
 
 Actions: ``raise`` (InjectedFault), ``hang`` (block on a module Event
 until ``release_hangs()``/``clear()``; ``hang5`` bounds it to 5 s),
-``corrupt`` (queried by the checkpoint writer via ``should_corrupt``).
+``corrupt`` (queried by the checkpoint writer via ``should_corrupt``),
+``press`` (sustained saturation queried via ``pressure`` — active from
+the first query until ``clear()``, or for ``press5`` = 5 s; drives the
+overload controller, runtime/overload.py).
 ``@N`` fires on exactly the Nth hit of that site; ``@0`` / omitted
 fires on every hit. Disarmed (the default) every hook is a single
 boolean check — zero cost on the hot path.
@@ -38,16 +42,18 @@ class InjectedFault(RuntimeError):
 
 
 class _Rule:
-    __slots__ = ("site", "action", "nth", "hang_s", "hits", "fired")
+    __slots__ = ("site", "action", "nth", "hang_s", "hits", "fired",
+                 "since")
 
     def __init__(self, site: str, action: str, nth: int,
                  hang_s: Optional[float]):
         self.site = site
         self.action = action
         self.nth = nth
-        self.hang_s = hang_s
+        self.hang_s = hang_s  # also the press duration for "press"
         self.hits = 0
         self.fired = 0
+        self.since: Optional[float] = None  # first press query (monotonic)
 
 
 _lock = threading.Lock()
@@ -56,7 +62,9 @@ _armed = False  # fast-path gate: hooks return immediately when False
 _unhang = threading.Event()
 
 _ENTRY = re.compile(
-    r"^(?P<site>[\w.\-]+):(?P<action>raise|corrupt|hang(?P<hang_s>\d+(\.\d+)?)?)"
+    r"^(?P<site>[\w.\-]+):(?P<action>raise|corrupt"
+    r"|hang(?P<hang_s>\d+(\.\d+)?)?"
+    r"|press(?P<press_s>\d+(\.\d+)?)?)"
     r"(?:@(?P<nth>\d+))?$"
 )
 
@@ -73,13 +81,17 @@ def configure(spec: str) -> None:
         if m is None:
             raise ValueError(
                 f"bad fault spec entry {raw!r} "
-                "(want site:action[@N], action in raise|hang[secs]|corrupt)"
+                "(want site:action[@N], action in "
+                "raise|hang[secs]|corrupt|press[secs])"
             )
         action = m.group("action")
         hang_s: Optional[float] = None
         if action.startswith("hang"):
             hang_s = float(m.group("hang_s")) if m.group("hang_s") else None
             action = "hang"
+        elif action.startswith("press"):
+            hang_s = float(m.group("press_s")) if m.group("press_s") else None
+            action = "press"
         entries[m.group("site")] = _Rule(
             m.group("site"), action, int(m.group("nth") or 0), hang_s
         )
@@ -151,6 +163,31 @@ def should_corrupt(site: str) -> bool:
         if r.nth and r.hits != r.nth:
             return False
         r.fired += 1
+        return True
+
+
+def pressure(site: str) -> bool:
+    """Sustained query-style saturation: True while an armed ``press``
+    rule for ``site`` is active. Unlike ``inject`` this does not
+    consume hits one-shot — the overload controller polls it every
+    tick; an unbounded rule stays active until ``clear()``, a bounded
+    one (``press5``) for that many seconds after its first query."""
+    if not _armed:
+        return False
+    import time as _time
+
+    with _lock:
+        r = _rules.get(site)
+        if r is None or r.action != "press":
+            return False
+        r.hits += 1
+        now = _time.monotonic()
+        if r.since is None:
+            r.since = now
+            r.fired += 1
+            _log.warning("injected backpressure at %s active", site)
+        if r.hang_s is not None and now - r.since > r.hang_s:
+            return False
         return True
 
 

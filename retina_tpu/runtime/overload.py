@@ -1,0 +1,294 @@
+"""Closed-loop overload controller: degrade resolution, never availability.
+
+BENCH_r05 showed the old failure mode: under sustained load the pipeline
+either ran at full rate or collapsed to 0 ev/s windows (binary
+nominal/degraded from the supervised-runtime PR). PSketch (PAPERS.md)
+and "Sketchy With a Chance of Adoption" argue a production sketch
+monitor must shed LOW-VALUE work first and keep heavy-hitter accuracy;
+this module is that control loop.
+
+The controller watches normalized pressure signals the engine feeds it
+(per-worker staging fill, dispatch in-flight fill, handoff wait rate,
+harvest lag — plus the ``feed.backpressure`` fault site for chaos
+tests) and moves the pipeline through explicit states with hysteresis::
+
+    NOMINAL ──p≥enter──► SAMPLING ──p≥shed──► SHEDDING ──p≥degrade──► DEGRADED
+       ◄──p≤exit for dwell_s── (one level per dwell period)
+
+* ``SAMPLING``: feed workers keep 1-in-k of the combined rows.
+  Priority-aware: heavy-hitter candidates (combined packet weight ≥
+  ``overload_exempt_packets``) and apiserver latency probes
+  (TSVAL/TSECR lanes) are exempt; the device step rescales the
+  surviving non-exempt rows by k (models/pipeline.py) so Count-Min /
+  HLL / entropy estimates stay unbiased (Horvitz-Thompson). The weight
+  synthesized by that rescaling is accounted in ``accuracy_debt``.
+* ``SHEDDING``: enrichment stages are dropped in the declared order
+  (``overload_shed_order``: DNS qname hashing → conntrack accounting →
+  per-pod label resolution) before any raw event is lost; the shed set
+  widens one stage per ``overload_shed_escalate_s`` while pressure
+  stays at/above the shed threshold.
+* ``DEGRADED``: every stage shed + sampling active; this is also where
+  crash-only recovery (engine._degraded) pins the controller.
+
+Window ticks ride the transfer mux control lane and a dedicated close
+semaphore (engine._submit_close_window), so a window is ALWAYS closed —
+annotated with ``sampled_fraction`` — never silently emitted as zero.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable
+
+import numpy as np
+
+from retina_tpu.events.schema import F
+from retina_tpu.log import logger
+from retina_tpu.metrics import get_metrics
+
+NOMINAL, SAMPLING, SHEDDING, DEGRADED = 0, 1, 2, 3
+STATE_NAMES = ("NOMINAL", "SAMPLING", "SHEDDING", "DEGRADED")
+
+# Enrichment stages sheddable in SHEDDING, in the only legal order:
+# cheapest-to-lose first (docs/operations.md §6).
+SHED_STAGES = ("dns", "conntrack", "labels")
+
+
+class OverloadController:
+    """State machine + host-side sampler. Thread-safe; ``tick`` is called
+    from the engine feed loop (bounded by ``overload_tick_s``), readers
+    (``sample_rows``/``shed_active``) run on feed workers and plugin
+    threads."""
+
+    def __init__(
+        self,
+        cfg,
+        signals: Callable[[], dict[str, float]] | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self._signals = signals or (lambda: {})
+        self.log = logger("overload")
+        self._lock = threading.Lock()
+        self._state = NOMINAL
+        self._shed_level = 0
+        self._pressure = 0.0
+        self._sigvals: dict[str, float] = {}
+        self._last_tick = 0.0
+        self._below_since: float | None = None
+        self._shed_above_since: float | None = None
+        self._transitions = 0
+        self._last_change = time.monotonic()
+        self._phase = 0  # rotating 1-in-k phase (avoids aliasing bias)
+        # Window-scoped accounting the engine snapshots+resets at close.
+        self._win_sampled = 0  # raw events dropped by the sampler
+        self._win_kept = 0  # raw events admitted (exempt + survivors)
+
+    # -- state machine -------------------------------------------------
+    def tick(self, now: float | None = None) -> int:
+        """Advance the state machine from the current pressure signals.
+        Cheap when called faster than ``overload_tick_s``."""
+        cfg = self.cfg
+        if not getattr(cfg, "overload_enabled", True):
+            return self._state
+        now = time.monotonic() if now is None else now
+        if now - self._last_tick < cfg.overload_tick_s:
+            return self._state
+        self._last_tick = now
+        try:
+            sig = self._signals() or {}
+        except Exception:
+            self.log.exception("overload signal read failed")
+            sig = {}
+        p = max(sig.values(), default=0.0)
+        with self._lock:
+            self._pressure = p
+            self._sigvals = dict(sig)
+            self._advance(p, now)
+            return self._state
+
+    def _advance(self, p: float, now: float) -> None:
+        cfg = self.cfg
+        # Escalation is immediate: sustained saturation must not wait
+        # out a dwell period while queues overflow.
+        target = NOMINAL
+        if p >= cfg.overload_enter_pressure:
+            target = SAMPLING
+        if p >= cfg.overload_shed_pressure:
+            target = SHEDDING
+        if p >= cfg.overload_degrade_pressure:
+            target = DEGRADED
+        if target > self._state:
+            self._set_state(target, p, now)
+            self._below_since = None
+            self._shed_above_since = now
+            return
+        # De-escalation: one level per dwell period with pressure at or
+        # below the EXIT threshold (enter > exit = the hysteresis band;
+        # brief dips never flap the state).
+        if self._state > NOMINAL and p <= cfg.overload_exit_pressure:
+            if self._below_since is None:
+                self._below_since = now
+            elif now - self._below_since >= cfg.overload_dwell_s:
+                self._set_state(self._state - 1, p, now)
+                self._below_since = now
+        else:
+            self._below_since = None
+        # Within SHEDDING, widen the shed set one stage per escalate
+        # period while pressure holds at/above the shed threshold.
+        if self._state == SHEDDING and p >= cfg.overload_shed_pressure:
+            if self._shed_above_since is None:
+                self._shed_above_since = now
+            elif (
+                now - self._shed_above_since >= cfg.overload_shed_escalate_s
+                and self._shed_level < len(self._shed_order())
+            ):
+                self._shed_level += 1
+                self._shed_above_since = now
+                self.log.warning(
+                    "overload: shedding widened to %s (pressure %.2f)",
+                    list(self._shed_order()[: self._shed_level]), p,
+                )
+        elif self._state != SHEDDING:
+            self._shed_above_since = None
+
+    def _set_state(self, state: int, p: float, now: float) -> None:
+        old = self._state
+        self._state = state
+        self._transitions += 1
+        self._last_change = now
+        if state >= SHEDDING:
+            self._shed_level = max(1, self._shed_level)
+        if state == DEGRADED:
+            self._shed_level = len(self._shed_order())
+        if state < SHEDDING:
+            self._shed_level = 0
+        get_metrics().overload_state.set(state)
+        log = self.log.warning if state > old else self.log.info
+        log(
+            "overload: %s -> %s (pressure %.2f, signals %s)",
+            STATE_NAMES[old], STATE_NAMES[state], p,
+            {k: round(v, 3) for k, v in self._sigvals.items()},
+        )
+
+    def _shed_order(self) -> tuple[str, ...]:
+        return tuple(getattr(self.cfg, "overload_shed_order", SHED_STAGES))
+
+    # -- read side ------------------------------------------------------
+    @property
+    def state(self) -> int:
+        return self._state
+
+    @property
+    def state_name(self) -> str:
+        return STATE_NAMES[self._state]
+
+    @property
+    def sample_k(self) -> int:
+        if self._state >= SAMPLING:
+            return max(1, int(self.cfg.overload_sample_k))
+        return 1
+
+    def shed_stages(self) -> tuple[str, ...]:
+        return self._shed_order()[: self._shed_level]
+
+    def shed_active(self, stage: str) -> bool:
+        return stage in self._shed_order()[: self._shed_level]
+
+    # -- sampler (feed-worker side) ------------------------------------
+    def sample_rows(self, rec: np.ndarray) -> tuple[np.ndarray, int]:
+        """Apply priority-aware 1-in-k sampling to combined rows.
+
+        Runs POST-combine (parallel/combine.py) and PRE-partition so a
+        row's packet weight is final: the device step recomputes the
+        SAME exemption predicate over the same rows and scales the
+        non-exempt survivors by k (models/pipeline.py), keeping every
+        packet-weighted estimate unbiased. Exempt (never sampled):
+        heavy-hitter candidates (packets >= overload_exempt_packets)
+        and apiserver latency probes (TSVAL/TSECR != 0); window ticks
+        never pass through here at all (control lane).
+
+        Returns ``(kept_rows, k)`` where k is 1 when not sampling.
+        """
+        k = self.sample_k
+        n = rec.shape[0]
+        if k <= 1 or n == 0:
+            if n:
+                self._win_kept += int(rec[:, F.PACKETS].sum())
+            return rec, 1
+        pk = rec[:, F.PACKETS]
+        exempt = pk >= np.uint32(self.cfg.overload_exempt_packets)
+        exempt |= (rec[:, F.TSVAL] | rec[:, F.TSECR]) != 0
+        idx = np.nonzero(~exempt)[0]
+        phase = self._phase
+        self._phase = (phase + idx.size) % k
+        keep = exempt.copy()
+        keep[idx[(np.arange(idx.size) + phase) % k == 0]] = True
+        kept = rec[keep]
+        dropped_ev = int(pk.sum()) - int(kept[:, F.PACKETS].sum())
+        if dropped_ev:
+            m = get_metrics()
+            m.events_sampled.inc(dropped_ev)
+            # Weight the device will synthesize back via x k scaling on
+            # the surviving non-exempt rows: the estimated (not
+            # observed) share of every sketch/counter.
+            debt = (k - 1) * int(kept[~exempt[keep], F.PACKETS].sum())
+            if debt:
+                m.accuracy_debt.inc(debt)
+        self._win_sampled += dropped_ev
+        self._win_kept += int(kept[:, F.PACKETS].sum())
+        return kept, k
+
+    def note_shed(self, stage: str, amount: int = 1) -> None:
+        """Account one shed enrichment unit (events for dns, passes for
+        conntrack/labels — see docs/metrics.md)."""
+        if amount:
+            get_metrics().events_shed.labels(stage=stage).inc(amount)
+
+    # -- window annotation ---------------------------------------------
+    def window_annotation(self) -> dict:
+        """Snapshot + reset the per-window sampling accounting; the
+        engine attaches this to every closed window (harvest item)."""
+        with self._lock:
+            sampled, kept = self._win_sampled, self._win_kept
+            self._win_sampled = 0
+            self._win_kept = 0
+            total = sampled + kept
+            return {
+                "overload_state": STATE_NAMES[self._state],
+                "sampled_fraction":
+                    (sampled / total) if total else 0.0,
+                "events_sampled": sampled,
+                "shed": list(self.shed_stages()),
+            }
+
+    # -- observability --------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "state": STATE_NAMES[self._state],
+                "pressure": round(self._pressure, 4),
+                "signals": {
+                    k: round(v, 4) for k, v in self._sigvals.items()
+                },
+                "sample_k": self.sample_k,
+                "shed": list(self.shed_stages()),
+                "transitions": self._transitions,
+                "since_change_s": round(
+                    time.monotonic() - self._last_change, 1
+                ),
+            }
+
+
+def validate_shed_order(order: Iterable[str]) -> tuple[str, ...]:
+    """Config-time check: a permutation-prefix of the known stages."""
+    order = tuple(order)
+    if len(set(order)) != len(order):
+        raise ValueError(f"overload_shed_order has duplicates: {order}")
+    unknown = set(order) - set(SHED_STAGES)
+    if unknown:
+        raise ValueError(
+            f"unknown overload shed stage(s) {sorted(unknown)}; "
+            f"known: {list(SHED_STAGES)}"
+        )
+    return order

@@ -52,7 +52,12 @@ def _ensure_thread() -> queue.Queue:
     global _q, _thread
     with _lock:
         if _q is None:
-            _q = queue.Queue()
+            # Proxy inbox: depth is already capped upstream by the
+            # bounded in-flight semaphores (engine._inflight /
+            # _close_inflight) and synchronous run_on_device waiters;
+            # a maxsize here could deadlock a waiter against its own
+            # done-event.
+            _q = queue.Queue()  # noqa: RT102 — bounded upstream, see above
             _thread = threading.Thread(
                 target=_loop, args=(_q,), name="device-proxy", daemon=True
             )

@@ -107,6 +107,19 @@ THREAD_RESTARTS = PREFIX + "thread_restarts_counter"
 ENGINE_ERRORS = PREFIX + "engine_errors_counter"
 DEGRADED_MODE = PREFIX + "tpu_degraded_mode"
 RECOVERY_SECONDS = PREFIX + "tpu_recovery_seconds"
+# Adaptive overload control (runtime/overload.py). overload_state is
+# the controller state as a number (0=NOMINAL 1=SAMPLING 2=SHEDDING
+# 3=DEGRADED); events_sampled counts raw (packet-weighted) events
+# dropped by the feed-worker 1-in-k sampler and re-represented on
+# device by x k rescaling; events_shed counts shed enrichment work per
+# stage (events for dns, passes for conntrack/labels, raw handoff
+# drops under stage="raw"); accuracy_debt is the cumulative packet
+# weight SYNTHESIZED by the device rescaling — the estimated (not
+# observed) share of the sketch totals.
+OVERLOAD_STATE = PREFIX + "tpu_overload_state"
+EVENTS_SAMPLED = PREFIX + "tpu_events_sampled_counter"
+EVENTS_SHED = PREFIX + "tpu_events_shed_counter"
+ACCURACY_DEBT = PREFIX + "tpu_accuracy_debt_counter"
 DEVICE_STEP_SECONDS = PREFIX + "tpu_step_seconds"
 DEVICE_BATCH_FILL = PREFIX + "tpu_batch_fill_ratio"
 WINDOWS_CLOSED = PREFIX + "tpu_windows_closed"

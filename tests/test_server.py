@@ -1,0 +1,118 @@
+"""Server regression tests: scrape behavior under render stalls.
+
+The /metrics render cache (server.py) must keep a scrape from ever
+blocking on a render: while the renderer is slow or stalled outright
+(device stall, harvest hang), scrapes serve the LAST COMPLETE exposition
+body with bounded latency instead of hanging or 500ing — the overload
+story's observability leg (docs/operations.md §6): a saturated pipeline
+still answers its scrapes.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from retina_tpu.server import Server
+
+
+def _get(port, path, timeout=5.0):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        ) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.fixture
+def srv_factory():
+    servers = []
+
+    def make(**kw):
+        s = Server("127.0.0.1:0", **kw)
+        s.start()
+        servers.append(s)
+        return s
+
+    yield make
+    for s in servers:
+        s.stop()
+
+
+def test_metrics_serves_last_complete_body_during_stall(srv_factory):
+    """A stalled renderer must not take /metrics down: every scrape
+    returns the last complete body, fast, for the whole serve-stale
+    grace period."""
+    stall = threading.Event()
+    release = threading.Event()
+
+    def gather():
+        if stall.is_set():
+            release.wait()  # renderer wedged (harvest hang analog)
+        return b"retina_window_events 42\n"
+
+    srv = srv_factory(gather=gather, metrics_cache_ttl_s=0.05)
+    try:
+        code, body = _get(srv.port, "/metrics")
+        assert code == 200 and b"retina_window_events 42" in body
+
+        stall.set()
+        time.sleep(0.1)  # TTL expired: every render now hangs
+        lats = []
+        for _ in range(20):
+            t0 = time.monotonic()
+            code, body = _get(srv.port, "/metrics")
+            lats.append(time.monotonic() - t0)
+            assert code == 200
+            # The LAST COMPLETE exposition, not an empty/partial one.
+            assert b"retina_window_events 42" in body
+            time.sleep(0.01)
+        assert max(lats) < 1.0, f"scrape blocked on stalled render: {lats}"
+    finally:
+        release.set()  # unwedge so Server.stop() joins promptly
+
+
+def test_scrape_p99_bounded_with_slow_render(srv_factory):
+    """With a render costing 0.3s (≫ scrape budget), serve-stale keeps
+    scrape latency flat: the render runs off the scrape path."""
+
+    def gather():
+        time.sleep(0.3)
+        return b"retina_up 1\n"
+
+    srv = srv_factory(gather=gather, metrics_cache_ttl_s=0.05)
+    lats = []
+    for _ in range(40):
+        t0 = time.monotonic()
+        code, _body = _get(srv.port, "/metrics")
+        lats.append(time.monotonic() - t0)
+        assert code == 200
+    lats.sort()
+    p99 = lats[int(len(lats) * 0.99)]
+    assert p99 < 0.25, f"scrape p99 {p99:.3f}s; render leaked onto scrape path"
+
+
+def test_debug_vars_exposes_overload_section(srv_factory):
+    """The overload controller's stats ride /debug/vars (wired in
+    controllermanager.init): state, pressure, and the active shed set
+    are what an operator checks first during an incident."""
+    stats = {"state": "SHEDDING", "pressure": 0.95, "shed": ["dns"]}
+    srv = srv_factory()
+    srv.expose_var("overload", lambda: stats)
+    code, body = _get(srv.port, "/debug/vars")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["overload"]["state"] == "SHEDDING"
+    assert doc["overload"]["shed"] == ["dns"]
+
+
+def test_health_routes(srv_factory):
+    srv = srv_factory(ready_check=lambda: False)
+    assert _get(srv.port, "/healthz")[0] == 200
+    assert _get(srv.port, "/readyz")[0] == 503
+    assert _get(srv.port, "/nope")[0] == 404

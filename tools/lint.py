@@ -25,6 +25,15 @@ Checks (all precise, no style opinions):
         Every swallow must at least log (rate-limited) and bump a
         named error counter; a deliberate swallow carries a
         `# noqa: RT101 — reason` on the except line.
+  RT102 unbounded stdlib queue constructed in retina_tpu/: a
+        `queue.Queue()` with no maxsize (or maxsize<=0), or a
+        `SimpleQueue()`, has no backpressure edge — under overload it
+        grows host memory without bound instead of surfacing as
+        drop-and-count/shed (docs/operations.md §6). Bounded queues
+        whose `.put()` blocks are fine: the bound IS the backpressure
+        edge. A deliberately unbounded queue carries a
+        `# noqa: RT102 — reason` on the construction line (e.g. the
+        engine harvest queue: window-cadence items, trivially small).
 
 `# noqa` (with or without a code) on the flagged line suppresses it.
 Exit code 1 if any finding. Usage: python tools/lint.py [paths...]
@@ -206,6 +215,46 @@ def check_file(path: Path) -> list[tuple[int, str, str]]:
                 add(node.lineno, "RT101",
                     "silent exception swallow (`except ...: pass`) — "
                     "log + count it, or noqa with a reason")
+
+    # RT102 — unbounded stdlib queues in production code. Matches the
+    # stdlib classes via `queue`/`queue_mod` attribute access or a
+    # direct `from queue import Queue` name; custom bounded queues
+    # (e.g. parallel/feed.TransferQueue) are out of scope by name.
+    if "retina_tpu" in path.parts:
+        q_classes = {"Queue", "LifoQueue", "PriorityQueue"}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            cls = None
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in ("queue", "queue_mod")):
+                cls = func.attr
+            elif (isinstance(func, ast.Name)
+                    and func.id in (q_classes | {"SimpleQueue"})):
+                cls = func.id
+            if cls == "SimpleQueue":
+                add(node.lineno, "RT102",
+                    "SimpleQueue is always unbounded — use a bounded "
+                    "queue.Queue(maxsize) or noqa with a reason")
+                continue
+            if cls not in q_classes:
+                continue
+            size = None
+            if node.args:
+                size = node.args[0]
+            for kw in node.keywords:
+                if kw.arg == "maxsize":
+                    size = kw.value
+            unbounded = size is None or (
+                isinstance(size, ast.Constant)
+                and isinstance(size.value, int) and size.value <= 0
+            )
+            if unbounded:
+                add(node.lineno, "RT102",
+                    f"unbounded {cls}() — no backpressure edge; pass "
+                    "maxsize or noqa with a reason")
     return finds
 
 
