@@ -32,8 +32,10 @@ distributed:
 	    python -m pytest tests/test_distributed_two_process.py -q
 
 # Critical-error gate (matches .github/workflows/lint.yaml). The TPU
-# image has no ruff/mypy; tools/lint.py is the offline mirror of the
-# high-precision ruff rules (CI runs the real ones).
+# image has no ruff/mypy; tools/lint.py runs the tools/analyze suite —
+# the offline mirror of the high-precision ruff rules PLUS the
+# repo-specific analyzers (thread safety, JAX trace purity,
+# metric/config drift). See docs/static-analysis.md.
 lint:
 	python -m compileall -q retina_tpu tests tools bench.py __graft_entry__.py
 	python tools/lint.py

@@ -236,7 +236,7 @@ class KubeJobRunner:
             time.sleep(self.poll_s)
         try:
             self.client.request(url, method="DELETE", timeout=30).close()
-        except Exception:  # noqa: BLE001 — best effort
+        except Exception:  # noqa: BLE001, RT101 — best-effort delete; the TimeoutError below surfaces the failure
             pass
         raise TimeoutError(
             f"capture job {name} did not complete within "

@@ -29,6 +29,14 @@ class Daemon:
     def __init__(self, cfg: Config, apiserver_host: str = ""):
         self.cfg = cfg
         self.log = logger("daemon")
+        if cfg.device_platform:
+            # Must land before the first device use in this process;
+            # jax.config is a no-op once a backend is initialized.
+            import jax
+
+            jax.config.update("jax_platforms", cfg.device_platform)
+            self.log.info("device platform forced: %s",
+                          cfg.device_platform)
         if enable_compilation_cache(cfg.compilation_cache_dir):
             self.log.info("XLA compilation cache at %s",
                           cfg.compilation_cache_dir)

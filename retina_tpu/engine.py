@@ -179,7 +179,7 @@ class SketchEngine:
 
         # Cached once: the trace flag is read on every dispatch.
         self._feed_trace = _os.environ.get("RETINA_FEED_TRACE") == "1"
-        self._desc_table: Any = None
+        self._desc_table: Any = None  # guarded-by: self._fd_lock
         # Bumped ONLY by failure resyncs (not by capacity-overflow
         # generation clears, which keep the device table intact and are
         # FIFO-safe for in-flight batches): a queued batch whose epoch
@@ -299,7 +299,7 @@ class SketchEngine:
         self._degraded = threading.Event()
         self._recover_lock = threading.Lock()
         self._recovering = False
-        self._recover_thread: threading.Thread | None = None
+        self._recover_thread: threading.Thread | None = None  # guarded-by: self._recover_lock
         self.recovery_failed = threading.Event()
         self.restarts = 0
         self._last_resume_src = ""
@@ -309,7 +309,7 @@ class SketchEngine:
         )
 
     # -- supervision helpers ------------------------------------------
-    def _register_hb(
+    def _register_hb(  # runs-on: feed-worker*, engine-recover, window-harvest
         self, name: str, deadline_s: float | None = None,
         on_stall: Optional[Callable[[], None]] = None,
     ) -> Heartbeat:
@@ -318,7 +318,7 @@ class SketchEngine:
             return self._supervisor.register(name, dl, on_stall)
         return Heartbeat(name, dl, on_stall)
 
-    def _deregister_hb(self, name: str) -> None:
+    def _deregister_hb(self, name: str) -> None:  # runs-on: feed-worker*
         if self._supervisor is not None:
             self._supervisor.deregister(name)
 
@@ -367,7 +367,12 @@ class SketchEngine:
         t = threading.Thread(
             target=self._recover, name="engine-recover", daemon=True
         )
-        self._recover_thread = t
+        # Publish under the lock: close()/join readers must never see
+        # a half-written reference from a concurrent fatal-error path
+        # (the _recovering flip above already serializes spawns, but
+        # the reference itself was unguarded).
+        with self._recover_lock:
+            self._recover_thread = t
         t.start()
 
     def _recover(self) -> None:
@@ -440,10 +445,10 @@ class SketchEngine:
             # (epoch bump drops queued pre-recovery batches).
             self._zero_u32 = None
             self._api_val = -1
-            self._desc_table = None
             self._sampk_dev = {}
-            if self._flow_dict is not None:
-                with self._fd_lock:
+            with self._fd_lock:
+                self._desc_table = None
+                if self._flow_dict is not None:
                     self._flow_dict.clear()
                     self._fd_epoch += 1
             resumed = False
@@ -692,7 +697,7 @@ class SketchEngine:
             out.append(b)
         return out
 
-    def _warm_close_job(self) -> None:
+    def _warm_close_job(self) -> None:  # runs-on: device-proxy
         """A REAL window close (with the close path's bookkeeping): its
         result rides the harvest queue like any window tick, so traffic
         (and any anomaly) ingested between ready and this warm
@@ -711,11 +716,11 @@ class SketchEngine:
         self._harvest_q.put(("win", stacked, meta))
         get_metrics().windows_closed.inc()
 
-    def _warm_snap_job(self) -> None:
+    def _warm_snap_job(self) -> None:  # runs-on: device-proxy
         snap = self.sharded.snapshot(self.state, 1)
         jax.block_until_ready(snap["totals"])
 
-    def _warm_snap_flat_job(self) -> None:
+    def _warm_snap_flat_job(self) -> None:  # runs-on: device-proxy
         self.sharded.snapshot_host(self.state, 1)
 
     def _warm_jobs(self) -> list[tuple[Any, Callable, tuple]]:
@@ -882,7 +887,7 @@ class SketchEngine:
         self._dispatch_sharded(sb, now_s, n_raw=len(records),
                                record_metrics=record_metrics)
 
-    def _ingest_fn(self, bucket: int, packed: bool):
+    def _ingest_fn(self, bucket: int, packed: bool):  # runs-on: device-proxy
         """Per-bucket jit that turns ONE transferred (D, bucket, P) wire
         array + a small metadata vector into step-ready device inputs:
         unpack the 12-lane wire format (when packed), slice the bucket
@@ -965,12 +970,18 @@ class SketchEngine:
         with self._fd_lock:
             self._flow_dict.clear()
             self._fd_epoch += 1
-        self._desc_table = None
+            self._desc_table = None
 
     def _ensure_desc_table(self):
         """(proxy thread) Device descriptor table, created by a zeros
-        jit ON device — never uploaded from host."""
-        if self._desc_table is None:
+        jit ON device — never uploaded from host. The jit build runs
+        outside _fd_lock (it can cold-compile); only this proxy-thread
+        method CREATES the table, so a concurrent resync can at worst
+        clear the slot, and storing a freshly-zeroed table over that
+        clear is exactly the state a resync wants."""
+        with self._fd_lock:
+            table = self._desc_table
+        if table is None:
             from functools import partial as _partial
 
             from retina_tpu.parallel.wire import PACKED_FIELDS
@@ -983,8 +994,10 @@ class SketchEngine:
             def mk():
                 return jnp.zeros(shape, jnp.uint32)
 
-            self._desc_table = mk()
-        return self._desc_table
+            table = mk()
+            with self._fd_lock:
+                self._desc_table = table
+        return table
 
     @staticmethod
     def _slice_windows(full, nv_i32, bucket: int, cap: int):
@@ -1005,7 +1018,7 @@ class SketchEngine:
             )
         return tuple(wins), tuple(nvs)
 
-    def _ingest_new_fn(self, bucket: int):
+    def _ingest_new_fn(self, bucket: int):  # runs-on: device-proxy
         """Per-bucket jit for NEW flow descriptors: (D, bucket, 13) wire
         of [table_id | 12 packed lanes] + meta + descriptor table ->
         scatter the lanes into the table (donated; id 0 is the overflow
@@ -1069,7 +1082,7 @@ class SketchEngine:
             self._pad_cache[key] = fn
         return fn
 
-    def _ingest_known_fn(self, bucket: int):
+    def _ingest_known_fn(self, bucket: int):  # runs-on: device-proxy
         """Per-bucket jit for KNOWN flows: (D, bucket, 2) wire of
         [table_id | packets << id_bits, bytes] + meta + descriptor
         table -> gather the resident 12-lane descriptors from HBM,
@@ -1357,7 +1370,14 @@ class SketchEngine:
                 wins, nvs, now_dev, lost_dev, table = (
                     self._ingest_new_fn(Bn)(new_dev, mn_dev, table)
                 )
-                self._desc_table = table
+                # Re-check the epoch at the store: a resync landing
+                # between this batch's entry check and here already
+                # invalidated the ids this table was built against —
+                # storing it would resurrect stale descriptors over
+                # the resync's cleared table.
+                with self._fd_lock:
+                    if self._fd_epoch == epoch:
+                        self._desc_table = table
                 sides.append((wins, nvs, now_dev, lost_dev))
             if have_known:
                 known_dev, mk_dev = devs[0], devs[1]
@@ -1681,7 +1701,7 @@ class SketchEngine:
                 )
                 self._harvest_thread.start()
 
-    def _restart_harvest(self) -> None:
+    def _restart_harvest(self) -> None:  # runs-on: watchdog
         """Watchdog escalation for a hung harvest thread (a wedged
         device_get on a dead link can block indefinitely): supersede it
         by bumping the generation and spawn a replacement. The hung
@@ -1891,7 +1911,7 @@ class SketchEngine:
             n = max(1, min(4, cores - 1))
         return n
 
-    def _busy_count(self) -> int:
+    def _busy_count(self) -> int:  # runs-on: feed-worker*
         """In-flight dispatch count for feed-worker interval-flush
         gating (same signal the inline feed loop reads)."""
         with self._busy_lock:
@@ -1967,7 +1987,7 @@ class SketchEngine:
         bench diag."""
         return self._overload.stats()
 
-    def _build_quantum(
+    def _build_quantum(  # runs-on: feed-worker*
         self, blocks: list[np.ndarray], n_raw: int, now_s: int
     ) -> list[tuple]:
         """Combine + partition one flush quantum into dispatchable step

@@ -200,7 +200,7 @@ class HubbleRelay:
         for chan in channels:
             try:
                 chan.close()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001, RT101 — shutdown path; a half-closed peer socket is expected
                 pass
         for t in self._threads:
             t.join(2.0)

@@ -155,7 +155,13 @@ class FeedWorker(threading.Thread):
         self.blocks_in = 0       # distributor-only
         self.events_out = 0      # worker-only
         self.blocks_out = 0      # worker-only
-        self.first_t = 0.0       # stamp of the oldest staged block
+        # Stamp of the oldest staged block. Written by BOTH the
+        # distributor (push, on empty->nonempty) and the worker
+        # (_flush restamp) without a lock: the race is bounded —
+        # a lost store skews ONE flush-age decision by at most one
+        # block interval, and a lock here would put the distributor's
+        # hot path behind every worker flush.
+        self.first_t = 0.0  # noqa: RT200 — benign bounded race, see above
         self.fill = 0.0          # last flush's quantum fill ratio
         self.batches = 0
         self.handoff_dropped = 0  # worker-only: items the consumer lost

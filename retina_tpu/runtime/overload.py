@@ -79,13 +79,13 @@ class OverloadController:
         self._shed_above_since: float | None = None
         self._transitions = 0
         self._last_change = time.monotonic()
-        self._phase = 0  # rotating 1-in-k phase (avoids aliasing bias)
+        self._phase = 0  # rotating 1-in-k phase  # guarded-by: self._lock
         # Window-scoped accounting the engine snapshots+resets at close.
-        self._win_sampled = 0  # raw events dropped by the sampler
-        self._win_kept = 0  # raw events admitted (exempt + survivors)
+        self._win_sampled = 0  # events dropped  # guarded-by: self._lock
+        self._win_kept = 0  # events admitted  # guarded-by: self._lock
 
     # -- state machine -------------------------------------------------
-    def tick(self, now: float | None = None) -> int:
+    def tick(self, now: float | None = None) -> int:  # runs-on: engine-dispatch
         """Advance the state machine from the current pressure signals.
         Cheap when called faster than ``overload_tick_s``."""
         cfg = self.cfg
@@ -196,7 +196,7 @@ class OverloadController:
         return stage in self._shed_order()[: self._shed_level]
 
     # -- sampler (feed-worker side) ------------------------------------
-    def sample_rows(self, rec: np.ndarray) -> tuple[np.ndarray, int]:
+    def sample_rows(self, rec: np.ndarray) -> tuple[np.ndarray, int]:  # runs-on: feed-worker*
         """Apply priority-aware 1-in-k sampling to combined rows.
 
         Runs POST-combine (parallel/combine.py) and PRE-partition so a
@@ -214,14 +214,23 @@ class OverloadController:
         n = rec.shape[0]
         if k <= 1 or n == 0:
             if n:
-                self._win_kept += int(rec[:, F.PACKETS].sum())
+                kept_ev = int(rec[:, F.PACKETS].sum())
+                with self._lock:
+                    self._win_kept += kept_ev
             return rec, 1
         pk = rec[:, F.PACKETS]
         exempt = pk >= np.uint32(self.cfg.overload_exempt_packets)
         exempt |= (rec[:, F.TSVAL] | rec[:, F.TSECR]) != 0
         idx = np.nonzero(~exempt)[0]
-        phase = self._phase
-        self._phase = (phase + idx.size) % k
+        # Under the lock: N feed workers sample concurrently, and an
+        # unlocked += here loses increments against both sibling
+        # workers and window_annotation's snapshot-and-reset — the
+        # window's sampled_fraction then lies about admitted traffic.
+        # Only the scalar bookkeeping is locked; the row selection
+        # stays outside.
+        with self._lock:
+            phase = self._phase
+            self._phase = (phase + idx.size) % k
         keep = exempt.copy()
         keep[idx[(np.arange(idx.size) + phase) % k == 0]] = True
         kept = rec[keep]
@@ -235,8 +244,10 @@ class OverloadController:
             debt = (k - 1) * int(kept[~exempt[keep], F.PACKETS].sum())
             if debt:
                 m.accuracy_debt.inc(debt)
-        self._win_sampled += dropped_ev
-        self._win_kept += int(kept[:, F.PACKETS].sum())
+        kept_ev = int(kept[:, F.PACKETS].sum())
+        with self._lock:
+            self._win_sampled += dropped_ev
+            self._win_kept += kept_ev
         return kept, k
 
     def note_shed(self, stage: str, amount: int = 1) -> None:
@@ -246,7 +257,7 @@ class OverloadController:
             get_metrics().events_shed.labels(stage=stage).inc(amount)
 
     # -- window annotation ---------------------------------------------
-    def window_annotation(self) -> dict:
+    def window_annotation(self) -> dict:  # runs-on: device-proxy
         """Snapshot + reset the per-window sampling accounting; the
         engine attaches this to every closed window (harvest item)."""
         with self._lock:

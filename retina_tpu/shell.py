@@ -309,7 +309,7 @@ def agent_status(api_addr: str, fetch=None) -> dict:
         out["reachable"] = True
         out["pods"] = doc.get("pods")
         out["filter_ips"] = doc.get("filter_ips")
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001, RT101 — debug probe; failure IS the result ("reachable": False)
         pass
     return out
 

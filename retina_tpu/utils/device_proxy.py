@@ -96,7 +96,7 @@ def submit_on_device(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
     if threading.current_thread() is _thread:
         try:
             fn(*args, **kwargs)
-        except BaseException:  # noqa: BLE001 — contract: fn self-handles
+        except BaseException:  # noqa: BLE001, RT101 — contract: fn self-handles errors (safe_* wrappers)
             pass
         return
     q = _ensure_thread()

@@ -1,0 +1,617 @@
+"""Fixture tests for the tools/analyze static-analysis framework.
+
+Each rule family gets fire / no-fire / noqa-suppressed cases on small
+synthetic snippets; the driver-level tests cover baseline suppression
+and exit codes.  The real repo staying finding-free is asserted
+separately by tests/test_lint_clean.py (tier 1).
+"""
+
+from __future__ import annotations
+
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.analyze import (  # noqa: E402
+    driver,
+    generic,
+    rt10x,
+    rt200,
+    rt210,
+    rt220,
+    rt230,
+)
+from tools.analyze.core import (  # noqa: E402
+    FileCtx,
+    Reporter,
+    noqa_codes,
+    save_baseline,
+)
+
+
+def run_rule(rule, src: str, rel: str = "retina_tpu/fake_mod.py"):
+    ctx = FileCtx(Path(rel), rel, textwrap.dedent(src))
+    assert ctx.syntax_error is None, ctx.syntax_error
+    rep = Reporter()
+    rule(ctx, rep)
+    return rep.findings
+
+
+def codes(findings) -> list[str]:
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------- core
+
+def test_noqa_parsing_is_code_aware():
+    assert noqa_codes("x = 1") is None
+    assert noqa_codes("x = 1  # noqa") == set()
+    assert noqa_codes("x = 1  # noqa: RT101") == {"RT101"}
+    assert noqa_codes("x  # noqa: BLE001, RT200 — reason") == \
+        {"BLE001", "RT200"}
+    # a noqa for a DIFFERENT code must not suppress this one
+    ctx = FileCtx(Path("retina_tpu/x.py"), "retina_tpu/x.py",
+                  "y = 1  # noqa: BLE001\n")
+    assert not ctx.suppressed(1, "RT101")
+    assert ctx.suppressed(1, "BLE001")
+
+
+# ------------------------------------------------------------- generic
+
+def test_e711_fire_nofire_noqa():
+    fire = run_rule(generic.check, "def f(x):\n    return x == None\n")
+    assert "E711" in codes(fire)
+    ok = run_rule(generic.check, "def f(x):\n    return x is None\n")
+    assert "E711" not in codes(ok)
+    sup = run_rule(
+        generic.check,
+        "def f(x):\n    return x == None  # noqa: E711\n")
+    assert "E711" not in codes(sup)
+
+
+def test_b006_mutable_default():
+    fire = run_rule(generic.check, "def f(x=[]):\n    return x\n")
+    assert "B006" in codes(fire)
+
+
+# --------------------------------------------------------------- RT100
+
+def test_rt100_engine_thread_spawn():
+    src = """
+        import threading
+
+        class SketchEngineLike:
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def sneaky(self):
+                threading.Thread(target=self._loop).start()
+    """
+    fire = run_rule(rt10x.check, src, rel="retina_tpu/engine.py")
+    assert codes(fire).count("RT100") == 1
+    assert "sneaky" in fire[0].message
+    # same snippet outside engine.py: out of scope
+    ok = run_rule(rt10x.check, src, rel="retina_tpu/other.py")
+    assert "RT100" not in codes(ok)
+
+
+# --------------------------------------------------------------- RT101
+
+def test_rt101_fire_and_logged_nofire():
+    fire = run_rule(rt10x.check, """
+        try:
+            f()
+        except Exception:
+            pass
+    """)
+    assert "RT101" in codes(fire)
+    ok = run_rule(rt10x.check, """
+        try:
+            f()
+        except Exception:
+            log.warning("boom")
+    """)
+    assert "RT101" not in codes(ok)
+
+
+def test_rt101_string_constant_body_is_silent():
+    # satellite: a bare string "explanation" is still a swallow
+    fire = run_rule(rt10x.check, '''
+        try:
+            f()
+        except Exception:
+            "best effort"
+    ''')
+    assert "RT101" in codes(fire)
+
+
+def test_rt101_noqa_on_except_or_last_body_line():
+    sup = run_rule(rt10x.check, """
+        try:
+            f()
+        except Exception:  # noqa: RT101 — reason
+            pass
+    """)
+    assert "RT101" not in codes(sup)
+    # satellite: noqa honored on the handler's LAST body line too
+    sup2 = run_rule(rt10x.check, """
+        try:
+            f()
+        except Exception:
+            pass  # noqa: RT101 — reason
+    """)
+    assert "RT101" not in codes(sup2)
+
+
+# --------------------------------------------------------------- RT102
+
+def test_rt102_unbounded_queue():
+    fire = run_rule(rt10x.check, "import queue\nq = queue.Queue()\n")
+    assert "RT102" in codes(fire)
+    ok = run_rule(rt10x.check, "import queue\nq = queue.Queue(8)\n")
+    assert "RT102" not in codes(ok)
+    simple = run_rule(
+        rt10x.check, "import queue\nq = queue.SimpleQueue()\n")
+    assert "RT102" in codes(simple)
+
+
+# --------------------------------------------------------------- RT200
+
+RACY = """
+    import threading
+
+    class Supervisor:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.counter = 0{decl_comment}
+
+        def start(self):
+            threading.Thread(
+                target=self._loop, name="loop-thread"
+            ).start()
+
+        def _loop(self):
+            {loop_write}
+
+        def poke(self):
+            {poke_write}
+"""
+
+
+def _racy(loop_write="self.counter = 1", poke_write="self.counter = 2",
+          decl_comment=""):
+    return RACY.format(loop_write=loop_write, poke_write=poke_write,
+                       decl_comment=decl_comment)
+
+
+def test_rt200_two_threads_no_lock_fires():
+    fire = run_rule(rt200.check, _racy())
+    assert "RT200" in codes(fire)
+    assert "Supervisor.counter" in fire[0].message
+
+
+def test_rt200_common_lock_no_fire():
+    ok = run_rule(rt200.check, _racy(
+        loop_write="with self._lock:\n                self.counter = 1",
+        poke_write="with self._lock:\n                self.counter = 2",
+    ))
+    assert "RT200" not in codes(ok)
+
+
+def test_rt200_single_thread_no_fire():
+    # both writes on the same (external) thread: no race
+    src = """
+        class Supervisor:
+            def __init__(self):
+                self.counter = 0
+
+            def poke(self):
+                self.counter = 1
+
+            def reset(self):
+                self.counter = 0
+    """
+    assert "RT200" not in codes(run_rule(rt200.check, src))
+
+
+def test_rt200_noqa_on_declaration_line():
+    sup = run_rule(rt200.check, _racy(
+        decl_comment="  # noqa: RT200 — benign test race"))
+    assert "RT200" not in codes(sup)
+
+
+def test_rt201_guarded_by_violation():
+    fire = run_rule(rt200.check, _racy(
+        decl_comment="  # guarded-by: self._lock",
+        loop_write="with self._lock:\n                self.counter = 1",
+        poke_write="self.counter = 2",
+    ))
+    assert codes(fire) == ["RT201"]
+    assert "poke" in fire[0].message
+    ok = run_rule(rt200.check, _racy(
+        decl_comment="  # guarded-by: self._lock",
+        loop_write="with self._lock:\n                self.counter = 1",
+        poke_write="with self._lock:\n                self.counter = 2",
+    ))
+    assert "RT201" not in codes(ok)
+
+
+def test_rt202_escaping_callback_needs_runs_on():
+    src = """
+        class Supervisor:
+            def start(self, pool):
+                pool.register(self._cb)
+
+            def _cb(self):{runs_on}
+                self.x = 1
+    """
+    fire = run_rule(rt200.check,
+                    textwrap.dedent(src).format(runs_on=""))
+    assert "RT202" in codes(fire)
+    ok = run_rule(
+        rt200.check,
+        textwrap.dedent(src).format(runs_on="  # runs-on: pool-worker"))
+    assert "RT202" not in codes(ok)
+
+
+def test_rt202_runs_on_threads_feed_rt200():
+    # the declared thread plus a plain method call = two writers
+    src = """
+        class Supervisor:
+            def __init__(self):
+                self.x = 0
+
+            def start(self, pool):
+                pool.register(self._cb)
+
+            def _cb(self):  # runs-on: pool-worker*
+                self.x = 1
+
+            def poke(self):
+                self.x = 2
+    """
+    fire = run_rule(rt200.check, src)
+    assert "RT200" in codes(fire)
+    assert "pool-worker*" in fire[0].message
+
+
+def test_rt203_unknown_guard_lock():
+    src = """
+        class Supervisor:
+            def __init__(self):
+                self.x = 0  # guarded-by: self._nonexistent
+    """
+    assert "RT203" in codes(run_rule(rt200.check, src))
+
+
+def test_rt204_malformed_runs_on():
+    src = """
+        class Supervisor:
+            def _cb(self):  # runs-on: bad thread name!
+                pass
+    """
+    assert "RT204" in codes(run_rule(rt200.check, src))
+
+
+def test_rt200_ignores_non_target_classes():
+    src = """
+        import threading
+
+        class SomethingElse:
+            def __init__(self):
+                self.x = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, name="t").start()
+
+            def _loop(self):
+                self.x = 1
+
+            def poke(self):
+                self.x = 2
+    """
+    assert run_rule(rt200.check, src) == []
+
+
+# --------------------------------------------------------------- RT210
+
+def test_rt210_side_effect_in_traced_fn():
+    src = """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            time.sleep(0.1)
+            return x
+    """
+    fire = run_rule(rt210.check, src)
+    assert "RT210" in codes(fire)
+
+
+def test_rt210_no_fire_outside_traced_fn():
+    src = """
+        import time
+
+        def host_loop(x):
+            time.sleep(0.1)
+            return x
+    """
+    assert run_rule(rt210.check, src) == []
+
+
+def test_rt211_concretization():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x) + 1
+    """
+    assert "RT211" in codes(run_rule(rt210.check, src))
+
+
+def test_rt212_branch_on_tracer_fire_and_static_ok():
+    fire = run_rule(rt210.check, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+    assert "RT212" in codes(fire)
+    ok = run_rule(rt210.check, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x is None:
+                return 0
+            if len(x) > 2:
+                return x
+            for i in range(x.shape[0]):
+                pass
+            return x
+    """)
+    assert "RT212" not in codes(ok)
+
+
+def test_rt212_static_argnames_excluded():
+    src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def step(x, mode):
+            if mode:
+                return x
+            return -x
+    """
+    assert "RT212" not in codes(run_rule(rt210.check, src))
+
+
+def test_rt213_attribute_mutation_in_traced_fn():
+    src = """
+        import jax
+
+        class M:
+            def build(self):
+                return jax.jit(self._step)
+
+            def _step(self, x):
+                self.calls = 1
+                return x
+    """
+    assert "RT213" in codes(run_rule(rt210.check, src))
+
+
+def test_rt210_noqa_suppression():
+    src = """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            time.sleep(0.1)  # noqa: RT210 — trace-time warm delay
+            return x
+    """
+    assert run_rule(rt210.check, src) == []
+
+
+# --------------------------------------------------- RT220 / RT230
+
+def _mini_repo(tmp_path, doc_metrics: str, doc_config: str,
+               metrics_src: str, config_src: str, usage_src: str):
+    files = {
+        "retina_tpu/utils/metric_names.py": metrics_src,
+        "retina_tpu/config.py": config_src,
+        "retina_tpu/app.py": usage_src,
+        "docs/metrics.md": doc_metrics,
+        "docs/configuration.md": doc_config,
+    }
+    ctxs = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        if rel.endswith(".py"):
+            ctxs.append(FileCtx(p, rel, p.read_text()))
+    return ctxs
+
+
+METRIC_DECLS = """
+    PREFIX = "networkobservability_"
+    FOO = PREFIX + "foo"
+    BAR = PREFIX + "bar"
+"""
+
+CONFIG_SRC = """
+    class Config:
+        window_seconds: int = 15
+        dead_knob: bool = False
+"""
+
+USAGE_SRC = """
+    from retina_tpu.utils import metric_names as mn
+
+    def setup(ex, cfg):
+        ex.new_gauge(mn.FOO, "doc")
+        ex.new_counter("networkobservability_rogue", "doc")
+        _ = cfg.window_seconds
+        _ = cfg.typo_knob
+"""
+
+
+def test_rt220_family(tmp_path):
+    ctxs = _mini_repo(
+        tmp_path,
+        doc_metrics="`networkobservability_foo` and "
+                    "`networkobservability_ghost`\n",
+        doc_config="window_seconds dead_knob\n",
+        metrics_src=METRIC_DECLS,
+        config_src=CONFIG_SRC,
+        usage_src=USAGE_SRC,
+    )
+    rep = Reporter()
+    rt220.check_program(ctxs, rep, tmp_path)
+    got = codes(rep.findings)
+    assert "RT220" in got   # rogue literal not declared
+    assert "RT222" in got   # BAR declared, not in docs
+    assert "RT223" in got   # docs mention ghost
+    assert "RT224" in got   # BAR never referenced
+    messages = " ".join(f.message for f in rep.findings)
+    assert "rogue" in messages and "ghost" in messages
+
+
+def test_rt221_literal_for_declared_series(tmp_path):
+    ctxs = _mini_repo(
+        tmp_path,
+        doc_metrics="`networkobservability_foo` "
+                    "`networkobservability_bar`\n",
+        doc_config="window_seconds dead_knob\n",
+        metrics_src=METRIC_DECLS,
+        config_src=CONFIG_SRC,
+        usage_src="""
+            from retina_tpu.utils import metric_names as mn
+
+            def setup(ex):
+                ex.new_gauge(mn.FOO, "d")
+                ex.new_gauge(mn.BAR, "d")
+                ex.new_counter("networkobservability_bar", "d")
+        """,
+    )
+    rep = Reporter()
+    rt220.check_program(ctxs, rep, tmp_path)
+    assert codes(rep.findings) == ["RT221"]
+
+
+def test_rt230_family(tmp_path):
+    ctxs = _mini_repo(
+        tmp_path,
+        doc_metrics="`networkobservability_foo` "
+                    "`networkobservability_bar`\n",
+        doc_config="window_seconds\n",  # dead_knob undocumented
+        metrics_src=METRIC_DECLS,
+        config_src=CONFIG_SRC,
+        usage_src=USAGE_SRC,
+    )
+    rep = Reporter()
+    rt230.check_program(ctxs, rep, tmp_path)
+    got = codes(rep.findings)
+    assert "RT230" in got   # cfg.typo_knob
+    assert "RT231" in got   # dead_knob never read
+    assert "RT232" in got   # dead_knob undocumented
+    assert not any(
+        "window_seconds" in f.message for f in rep.findings)
+
+
+def test_rt230_foreign_cfg_annotation_opts_out(tmp_path):
+    ctxs = _mini_repo(
+        tmp_path,
+        doc_metrics="`networkobservability_foo` "
+                    "`networkobservability_bar`\n",
+        doc_config="window_seconds dead_knob\n",
+        metrics_src=METRIC_DECLS,
+        config_src=CONFIG_SRC,
+        usage_src="""
+            def run(cfg: ShellConfig):
+                return cfg.not_an_agent_knob
+
+            def agent(cfg):
+                return (cfg.window_seconds, cfg.dead_knob)
+        """,
+    )
+    rep = Reporter()
+    rt230.check_program(ctxs, rep, tmp_path)
+    assert rep.findings == []
+
+
+# ----------------------------------------------------------- driver
+
+def _driver_repo(tmp_path) -> Path:
+    """Minimal tree the driver can analyze end to end: one RT101."""
+    pkg = tmp_path / "retina_tpu"
+    pkg.mkdir()
+    (pkg / "x.py").write_text(
+        "try:\n    f()\nexcept Exception:\n    pass\n")
+    return tmp_path
+
+
+def test_driver_exits_nonzero_on_live_finding(tmp_path, monkeypatch):
+    root = _driver_repo(tmp_path)
+    monkeypatch.setattr(
+        driver, "BASELINE_PATH", tmp_path / "baseline.json")
+    out: list[str] = []
+    rc = driver.run([], root=root, out=out.append)
+    assert rc == 1
+    assert any("RT101" in line for line in out)
+    assert any("1 finding(s), 0 baselined" in line for line in out)
+
+
+def test_driver_baseline_suppression(tmp_path, monkeypatch):
+    root = _driver_repo(tmp_path)
+    findings = driver.analyze(root)
+    assert len(findings) == 1
+    bpath = tmp_path / "baseline.json"
+    save_baseline(bpath, {findings[0].key: "reviewed: test fixture"})
+    monkeypatch.setattr(driver, "BASELINE_PATH", bpath)
+    out: list[str] = []
+    rc = driver.run([], root=root, out=out.append)
+    assert rc == 0
+    assert any("0 finding(s), 1 baselined" in line for line in out)
+
+
+def test_driver_stale_baseline_warns(tmp_path, monkeypatch):
+    root = _driver_repo(tmp_path)
+    (root / "retina_tpu" / "x.py").write_text("x = 1\n")  # finding gone
+    bpath = tmp_path / "baseline.json"
+    save_baseline(bpath, {"RT101:retina_tpu/x.py:3": "obsolete"})
+    monkeypatch.setattr(driver, "BASELINE_PATH", bpath)
+    out: list[str] = []
+    rc = driver.run([], root=root, out=out.append)
+    assert rc == 0
+    assert any("stale baseline" in line for line in out)
+
+
+def test_driver_path_restriction_reports_subset(tmp_path, monkeypatch):
+    root = _driver_repo(tmp_path)
+    (root / "retina_tpu" / "y.py").write_text(
+        "try:\n    f()\nexcept Exception:\n    pass\n")
+    monkeypatch.setattr(
+        driver, "BASELINE_PATH", tmp_path / "baseline.json")
+    out: list[str] = []
+    rc = driver.run(["retina_tpu/y.py"], root=root, out=out.append)
+    assert rc == 1
+    assert any("y.py" in line and "RT101" in line for line in out)
+    assert not any("x.py:" in line for line in out)
+
+
+def test_shipped_baseline_is_empty():
+    from tools.analyze.core import load_baseline
+    assert load_baseline(driver.BASELINE_PATH) == {}

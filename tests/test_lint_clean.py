@@ -1,0 +1,46 @@
+"""Tier-1 gate: the repo itself is finding-free under tools/analyze.
+
+Runs the real CLI as a subprocess (exactly what `make lint` and CI
+run) and asserts exit 0 — every rule family over the whole tree,
+modulo the reviewed baseline (which ships empty; see
+docs/static-analysis.md).  A finding introduced anywhere in the repo
+fails this test with the finding text in the assertion message.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_repo_is_lint_clean():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py")],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (
+        "tools/lint.py found non-baselined findings:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+    assert "0 finding(s)" in proc.stdout, proc.stdout
+
+
+def test_lint_runs_all_rule_families():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"),
+         "--list-rules"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0
+    for family in ("generic", "RT100", "RT101", "RT102", "RT200",
+                   "RT210", "RT220", "RT230"):
+        assert family in proc.stdout, f"missing family {family}"

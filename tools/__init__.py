@@ -1,0 +1,2 @@
+# Package marker so `tools.analyze` is importable from the repo root
+# (tests/test_analyze.py imports the analyzer modules in-process).

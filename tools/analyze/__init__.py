@@ -1,0 +1,30 @@
+"""Whole-program static analysis for retina_tpu.
+
+The suite grew out of tools/lint.py (RT100-RT102): cheap AST rules
+catch real concurrency and drift bugs in this codebase, so the rules
+now live in a shared framework with one parse per file, per-finding
+suppression (`# noqa: RTxxx — reason`) and a reviewed baseline file
+(tools/analyze/baseline.json) for accepted pre-existing findings.
+
+Rule families (catalog + rationale: docs/static-analysis.md):
+  generic  F401 E711 E722 F541 F601 F811 B006 B011  (ruff subset)
+  rt10x    RT100 engine thread-spawn protocol
+           RT101 silent exception swallow
+           RT102 unbounded stdlib queue
+  rt200    RT200-RT204 thread-safety: attributes of the hot classes
+           indexed by the threads that reach them (spawn sites,
+           supervisor.spawn targets, `# runs-on:` annotations); writes
+           from >=2 threads need a common lock or a declared
+           `# guarded-by: self._lock`.
+  rt210    RT210-RT214 JAX trace purity: side effects and tracer
+           branching inside jit/shard_map-traced functions.
+  rt220    RT220-RT224 metric-name drift between utils/metric_names.py,
+           registration sites and docs/metrics.md.
+  rt230    RT230-RT232 config-knob drift between config.py fields,
+           cfg.<attr> reads and docs/configuration.md.
+
+Entry point: tools/lint.py (CLI) or tools.analyze.driver.run().
+"""
+
+from tools.analyze.core import FileCtx, Finding  # noqa: F401
+from tools.analyze.driver import run  # noqa: F401

@@ -1,0 +1,150 @@
+"""Shared analysis infrastructure: parsed-file context, findings,
+noqa suppression and the reviewed baseline.
+
+Every rule module reports through ``Reporter.add`` so suppression is
+uniform: a ``# noqa`` comment on the flagged line (or on an explicitly
+nominated companion line, e.g. the attribute's declaration in
+``__init__`` for RT200) silences the finding.  ``# noqa: RT101`` is
+code-aware — it silences only the listed codes; a bare ``# noqa``
+silences everything on that line.
+
+Findings carry a *stable key* (rule-chosen, not a raw line number
+where avoidable) so the baseline file survives unrelated edits:
+``RT200:retina_tpu/engine.py:SketchEngine._desc_table`` stays valid
+however the file shifts.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Iterable
+
+NOQA_RE = re.compile(
+    r"#\s*noqa\b(?:\s*:\s*(?P<codes>[A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*))?",
+)
+
+
+def noqa_codes(line: str) -> set[str] | None:
+    """Return the set of codes a noqa comment on `line` suppresses.
+
+    None  -> no noqa comment at all
+    set() -> bare `# noqa` (suppresses every code)
+    {...} -> `# noqa: RT101, RT200` (suppresses only those codes)
+    """
+    m = NOQA_RE.search(line)
+    if m is None:
+        return None
+    codes = m.group("codes")
+    if not codes:
+        return set()
+    return {c.strip() for c in codes.split(",")}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative posix path
+    line: int
+    code: str
+    message: str
+    key: str  # stable id used for baseline matching
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+class FileCtx:
+    """One parsed source file, shared by every rule (parse once)."""
+
+    def __init__(self, path: Path, rel: str, src: str):
+        self.path = path
+        self.rel = rel
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree: ast.Module | None = None
+        self.syntax_error: SyntaxError | None = None
+        try:
+            self.tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:  # surfaced as E999 by the driver
+            self.syntax_error = e
+
+    def line_at(self, lineno: int) -> str:
+        if 0 < lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, lineno: int, code: str) -> bool:
+        codes = noqa_codes(self.line_at(lineno))
+        if codes is None:
+            return False
+        return not codes or code in codes
+
+
+class Reporter:
+    """Collects findings, applying noqa suppression at add() time."""
+
+    def __init__(self) -> None:
+        self.findings: list[Finding] = []
+
+    def add(
+        self,
+        ctx: FileCtx,
+        lineno: int,
+        code: str,
+        message: str,
+        key: str | None = None,
+        also_noqa_lines: Iterable[int] = (),
+    ) -> None:
+        """Report `code` at ctx:lineno unless a noqa suppresses it.
+
+        `also_noqa_lines` nominates companion lines whose noqa also
+        counts (RT101: the handler's last body line; RT200: the
+        attribute's declaration line in __init__).
+        `key` defaults to CODE:path:line — rules pass a semantic
+        suffix (attr / metric / import name) where one exists so the
+        baseline is robust to unrelated line drift.
+        """
+        for ln in (lineno, *also_noqa_lines):
+            if ctx.suppressed(ln, code):
+                return
+        self.findings.append(
+            Finding(
+                path=ctx.rel,
+                line=lineno,
+                code=code,
+                message=message,
+                key=key or f"{code}:{ctx.rel}:{lineno}",
+            )
+        )
+
+
+# ----------------------------------------------------------------------
+# Baseline: reviewed pre-existing findings, keyed by Finding.key, each
+# with a written reason.  The acceptance bar for this repo is an EMPTY
+# baseline (fix at source or noqa with a reason at the site); the file
+# exists so a future true-but-deferred finding can land without
+# blocking CI, visibly and with an owner-reviewed reason string.
+
+def load_baseline(path: Path) -> dict[str, str]:
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    entries = data.get("findings", {})
+    if isinstance(entries, list):  # tolerate list-of-objects form
+        return {e["key"]: e.get("reason", "") for e in entries}
+    return dict(entries)
+
+
+def save_baseline(path: Path, entries: dict[str, str]) -> None:
+    payload = {
+        "_comment": (
+            "Reviewed pre-existing findings. Key -> reason. Keep this "
+            "empty: prefer fixing at source or a `# noqa: CODE — "
+            "reason` at the site. See docs/static-analysis.md."
+        ),
+        "findings": dict(sorted(entries.items())),
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n")

@@ -1,0 +1,172 @@
+"""Analysis driver: discover files, parse once, run every rule
+module, apply the baseline, exit nonzero on any live finding.
+
+Per-file rules (generic, rt10x, rt200, rt210) see one FileCtx at a
+time; whole-program rules (rt220, rt230) see the full parsed set —
+they cross-reference metric/config declarations, use sites and docs,
+so they always scan the complete default file set even when the CLI
+restricts which files findings are *reported* for.
+
+Usage:
+    python tools/lint.py [paths...] [--update-baseline] [--list-rules]
+
+Exit code 1 if any non-baselined finding survives suppression.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+from tools.analyze import generic, rt10x, rt200, rt210, rt220, rt230
+from tools.analyze.core import (
+    FileCtx,
+    Finding,
+    Reporter,
+    load_baseline,
+    save_baseline,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+# Everything the repo ships as Python, minus vendored/derived trees.
+DEFAULT_TARGETS = (
+    "retina_tpu",
+    "tests",
+    "tools",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+FILE_RULES = (generic.check, rt10x.check, rt200.check, rt210.check)
+PROGRAM_RULES = (rt220.check_program, rt230.check_program)
+
+RULE_FAMILIES = {
+    "generic": "F401 F541 F601 F811 E711 E722 B006 B011 (+E999)",
+    "RT100": "engine thread-spawn outside sanctioned helpers",
+    "RT101": "silent exception swallow",
+    "RT102": "unbounded stdlib queue",
+    "RT200": "cross-thread write without a common/declared lock "
+             "(+RT201 guarded-by violation, RT202 unannotated "
+             "escaping callback, RT203 unknown guarded-by lock, "
+             "RT204 unknown runs-on spelling)",
+    "RT210": "side effect inside a traced function (+RT211 host "
+             "readback, RT212 tracer branching, RT213 state "
+             "mutation, RT214 re-jit inside a traced body)",
+    "RT220": "metric registered but not declared (+RT221 literal "
+             "metric name, RT222 undocumented series, RT223 doc "
+             "mentions unknown series, RT224 declared-but-unused)",
+    "RT230": "unknown cfg.<attr> access (+RT231 field never read, "
+             "RT232 field undocumented)",
+}
+
+
+def discover(root: Path) -> list[Path]:
+    files: list[Path] = []
+    for target in DEFAULT_TARGETS:
+        p = root / target
+        if p.is_dir():
+            files += sorted(p.rglob("*.py"))
+        elif p.suffix == ".py" and p.exists():
+            files.append(p)
+    return [f for f in files if "__pycache__" not in f.parts]
+
+
+def parse_all(root: Path) -> list[FileCtx]:
+    ctxs = []
+    for f in discover(root):
+        rel = f.relative_to(root).as_posix()
+        ctxs.append(FileCtx(f, rel, f.read_text()))
+    return ctxs
+
+
+def analyze(root: Path | None = None) -> list[Finding]:
+    """Run every rule over the default file set; no baseline applied."""
+    root = root or REPO_ROOT
+    ctxs = parse_all(root)
+    rep = Reporter()
+    for ctx in ctxs:
+        if ctx.syntax_error is not None:
+            e = ctx.syntax_error
+            rep.add(ctx, e.lineno or 0, "E999", f"syntax error: {e.msg}")
+            continue
+        for rule in FILE_RULES:
+            rule(ctx, rep)
+    good = [c for c in ctxs if c.syntax_error is None]
+    for prule in PROGRAM_RULES:
+        prule(good, rep, root)
+    return rep.findings
+
+
+def run(
+    argv: list[str] | None = None,
+    root: Path | None = None,
+    out=print,
+) -> int:
+    argv = list(argv or [])
+    root = root or REPO_ROOT
+    update_baseline = "--update-baseline" in argv
+    if "--list-rules" in argv:
+        for fam, desc in RULE_FAMILIES.items():
+            out(f"{fam:8s} {desc}")
+        return 0
+    path_args = [a for a in argv if not a.startswith("--")]
+
+    t0 = time.monotonic()
+    findings = analyze(root)
+
+    if path_args:
+        # Restrict *reporting* to the requested paths; whole-program
+        # rules still analyzed the full tree (they must — drift is a
+        # cross-file property).
+        wanted = [
+            (root / a).resolve().relative_to(root).as_posix()
+            for a in path_args
+        ]
+
+        def selected(f: Finding) -> bool:
+            return any(
+                f.path == w or f.path.startswith(w.rstrip("/") + "/")
+                for w in wanted
+            )
+
+        findings = [f for f in findings if selected(f)]
+
+    baseline = load_baseline(BASELINE_PATH)
+    live = [f for f in findings if f.key not in baseline]
+    baselined = [f for f in findings if f.key in baseline]
+    seen_keys = {f.key for f in findings}
+    stale = sorted(k for k in baseline if k not in seen_keys)
+
+    if update_baseline:
+        for f in live:
+            baseline[f.key] = "TODO(review): baselined by --update-baseline"
+        for k in stale:
+            baseline.pop(k)
+        save_baseline(BASELINE_PATH, baseline)
+        out(f"lint: baseline updated ({len(baseline)} entries) — "
+            "review the TODO reasons before committing")
+        return 0
+
+    for f in sorted(live, key=lambda f: (f.path, f.line, f.code)):
+        out(f.render())
+    if not path_args:
+        for k in stale:
+            out(f"warning: stale baseline entry (no longer fires): {k}")
+    dt = time.monotonic() - t0
+    n_files = len(discover(root))
+    out(
+        f"lint: {n_files} files, {len(live)} finding(s), "
+        f"{len(baselined)} baselined, {dt:.1f}s"
+    )
+    return 1 if live else 0
+
+
+def main(argv: list[str]) -> int:
+    return run(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

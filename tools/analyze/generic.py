@@ -1,0 +1,135 @@
+"""Generic correctness rules — the high-precision subset of ruff's
+F/E9/B families (no third-party linters in the TPU image; CI runs
+real ruff+mypy where pip is available).
+
+  F401  module-level import never used (skipped in __init__.py
+        re-export surfaces and for names listed in __all__)
+  F541  f-string without placeholders
+  F601  duplicate dict literal key
+  F811  duplicate top-level def/class name
+  E711  comparison to None with ==/!=
+  E722  bare `except:`
+  B006  mutable default argument (list/dict/set literal)
+  B011  assert on a non-empty tuple (always true)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import FileCtx, Reporter
+
+
+def _names_loaded(tree: ast.AST) -> set[str]:
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # a.b.c -> root name a (covers `import a.b` usage)
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+    return used
+
+
+def _all_exports(tree: ast.Module) -> set[str]:
+    out: set[str] = set()
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str):
+                    out.add(elt.value)
+    return out
+
+
+def check(ctx: FileCtx, rep: Reporter) -> None:
+    tree = ctx.tree
+    assert tree is not None
+    used = _names_loaded(tree)
+    exported = _all_exports(tree)
+
+    # F401 — only module-level imports; conftest/test fixtures are
+    # excluded by the driver's path selection.
+    if ctx.path.name != "__init__.py":
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    if name not in used and name not in exported:
+                        rep.add(ctx, node.lineno, "F401",
+                                f"`import {a.name}` unused",
+                                key=f"F401:{ctx.rel}:{a.name}")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    name = a.asname or a.name
+                    if name not in used and name not in exported:
+                        rep.add(ctx, node.lineno, "F401",
+                                f"`from {node.module} import "
+                                f"{a.name}` unused",
+                                key=f"F401:{ctx.rel}:{name}")
+
+    seen_top: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if node.name in seen_top:
+                rep.add(ctx, node.lineno, "F811",
+                        f"`{node.name}` redefines line "
+                        f"{seen_top[node.name]}",
+                        key=f"F811:{ctx.rel}:{node.name}")
+            seen_top[node.name] = node.lineno
+
+    # Format specs (f"{x:.1f}") parse as JoinedStr children of
+    # FormattedValue — not user f-strings; exclude them from F541.
+    spec_ids = {
+        id(n.format_spec) for n in ast.walk(tree)
+        if isinstance(n, ast.FormattedValue) and n.format_spec is not None
+    }
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            rep.add(ctx, node.lineno, "E722", "bare `except:`")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in (*node.args.defaults, *node.args.kw_defaults):
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    rep.add(ctx, d.lineno, "B006",
+                            "mutable default argument")
+        elif isinstance(node, ast.JoinedStr):
+            if id(node) not in spec_ids and not any(
+                    isinstance(v, ast.FormattedValue)
+                    for v in node.values):
+                rep.add(ctx, node.lineno, "F541",
+                        "f-string without placeholders")
+        elif isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if (isinstance(op, (ast.Eq, ast.NotEq))
+                        and isinstance(comp, ast.Constant)
+                        and comp.value is None):
+                    rep.add(ctx, node.lineno, "E711",
+                            "comparison to None (use `is`/`is not`)")
+        elif isinstance(node, ast.Dict):
+            keys = [
+                k.value for k in node.keys
+                if isinstance(k, ast.Constant)
+                and isinstance(k.value, (str, int))
+            ]
+            dupes = {k for k in keys if keys.count(k) > 1}
+            if dupes:
+                rep.add(ctx, node.lineno, "F601",
+                        f"duplicate dict key(s): "
+                        f"{sorted(map(str, dupes))}")
+        elif isinstance(node, ast.Assert):
+            if isinstance(node.test, ast.Tuple) and node.test.elts:
+                rep.add(ctx, node.lineno, "B011",
+                        "assert on a tuple is always true")

@@ -1,0 +1,140 @@
+"""Repo-specific runtime rules RT100-RT102 (migrated from the
+original tools/lint.py, which is now a thin entry point).
+
+  RT100 threading.Thread spawned in engine.py outside the sanctioned
+        helpers (start, start_background_warm, _ensure_harvest_thread,
+        _request_recovery).
+        Every engine thread must be created where shutdown joins it —
+        a thread spawned ad hoc escapes the stop/join protocol and the
+        device-proxy single-thread invariant review.
+  RT101 silent exception swallow in retina_tpu/: an `except` handler
+        whose body is only `pass`/`...`/a bare string constant hides
+        failures from operators.  Every swallow must at least log
+        (rate-limited) and bump a named error counter; a deliberate
+        swallow carries a `# noqa: RT101 — reason` on the except line
+        or on the handler's last body line.
+  RT102 unbounded stdlib queue constructed in retina_tpu/: a
+        `queue.Queue()` with no maxsize (or maxsize<=0), or a
+        `SimpleQueue()`, has no backpressure edge — under overload it
+        grows host memory without bound instead of surfacing as
+        drop-and-count/shed (docs/operations.md §6).  Bounded queues
+        whose `.put()` blocks are fine: the bound IS the backpressure
+        edge.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import FileCtx, Reporter
+
+ENGINE_SANCTIONED = {
+    "start", "start_background_warm", "_ensure_harvest_thread",
+    "_request_recovery",
+}
+
+
+def _check_rt100(ctx: FileCtx, rep: Reporter) -> None:
+    if ctx.path.name != "engine.py":
+        return
+
+    def _walk_fn(node: ast.AST, fn: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            nxt = fn
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Nested defs (closures like _warm) belong to the
+                # sanctioned outer helper that defines them.
+                nxt = fn if fn in ENGINE_SANCTIONED else child.name
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr == "Thread"
+                    and isinstance(child.func.value, ast.Name)
+                    and child.func.value.id == "threading"
+                    and fn not in ENGINE_SANCTIONED):
+                rep.add(ctx, child.lineno, "RT100",
+                        "threading.Thread spawned outside sanctioned "
+                        f"engine helpers (in `{fn or '<module>'}`)",
+                        key=f"RT100:{ctx.rel}:{fn or '<module>'}")
+            _walk_fn(child, nxt)
+
+    _walk_fn(ctx.tree, None)
+
+
+def _body_is_silent(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body does nothing observable.
+
+    `pass`, `...` and bare string constants (docstring-equivalents —
+    an explanation is not an action; the failure is still invisible
+    to operators) all count as silent.
+    """
+    return all(
+        isinstance(stmt, ast.Pass)
+        or (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and (stmt.value.value is Ellipsis
+                 or isinstance(stmt.value.value, str)))
+        for stmt in handler.body
+    )
+
+
+def _check_rt101(ctx: FileCtx, rep: Reporter) -> None:
+    if "retina_tpu" not in ctx.path.parts:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _body_is_silent(node):
+            # A swallow annotated inside the handler (the last body
+            # line, where a multi-line explanation naturally ends)
+            # is as deliberate as one annotated on the except line.
+            last = node.body[-1]
+            last_line = getattr(last, "end_lineno", last.lineno)
+            rep.add(ctx, node.lineno, "RT101",
+                    "silent exception swallow (`except ...: pass`) — "
+                    "log + count it, or noqa with a reason",
+                    also_noqa_lines=(last_line,))
+
+
+def _check_rt102(ctx: FileCtx, rep: Reporter) -> None:
+    if "retina_tpu" not in ctx.path.parts:
+        return
+    q_classes = {"Queue", "LifoQueue", "PriorityQueue"}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        cls = None
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("queue", "queue_mod")):
+            cls = func.attr
+        elif (isinstance(func, ast.Name)
+                and func.id in (q_classes | {"SimpleQueue"})):
+            cls = func.id
+        if cls == "SimpleQueue":
+            rep.add(ctx, node.lineno, "RT102",
+                    "SimpleQueue is always unbounded — use a bounded "
+                    "queue.Queue(maxsize) or noqa with a reason")
+            continue
+        if cls not in q_classes:
+            continue
+        size = None
+        if node.args:
+            size = node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "maxsize":
+                size = kw.value
+        unbounded = size is None or (
+            isinstance(size, ast.Constant)
+            and isinstance(size.value, int) and size.value <= 0
+        )
+        if unbounded:
+            rep.add(ctx, node.lineno, "RT102",
+                    f"unbounded {cls}() — no backpressure edge; pass "
+                    "maxsize or noqa with a reason")
+
+
+def check(ctx: FileCtx, rep: Reporter) -> None:
+    _check_rt100(ctx, rep)
+    _check_rt101(ctx, rep)
+    _check_rt102(ctx, rep)

@@ -1,0 +1,467 @@
+"""RT200-RT204 — thread-safety of the hot runtime classes.
+
+The runtime is a dozen supervised threads sharing engine state; the
+correctness contract is "every shared attribute has a declared owner
+lock".  This analyzer machine-checks it: every attribute of the
+target classes (SketchEngine, OverloadController, FeedWorkerPool,
+FeedWorker, Supervisor) is indexed by the THREADS that write it and
+the LOCKS held at each write, then:
+
+  RT200 attribute written from >= 2 threads with no common lock and
+        no declared guard
+  RT201 write to a `# guarded-by:`-declared attribute without the
+        declared lock held
+  RT202 method escapes as a callback (referenced as a value, passed
+        across a class boundary) without a `# runs-on:` annotation —
+        the analyzer cannot attribute its writes to a thread, so the
+        contract requires the author to declare it
+  RT203 `# guarded-by:` names a lock that is not an attribute
+        initialized in __init__
+  RT204 malformed `# runs-on:` thread name
+
+Thread attribution
+------------------
+Thread roots come from the sanctioned spawn sites and annotations:
+
+  * ``threading.Thread(target=self.m, name="engine-dispatch")`` and
+    ``supervisor.spawn("checkpointer", self.m, ...)`` root `m` on the
+    named thread;
+  * ``run_on_device(fn)`` / ``submit_on_device(fn)`` root `fn` on the
+    single ``device-proxy`` thread (utils/device_proxy.py);
+  * ``run()`` of a ``threading.Thread`` subclass roots on
+    ``<Class>.run*`` — the trailing ``*`` marks a POOL of threads
+    (every instance gets one), which alone counts as two writers;
+  * ``# runs-on: thread-a, thread-b*`` on a def line declares roots
+    the analyzer cannot see (cross-class callbacks);
+  * public methods with none of the above run on one shared
+    ``external`` caller thread — a deliberate under-approximation
+    (concurrent external callers are the API owner's contract, and
+    modeling each public method as its own thread would drown real
+    findings in noise).
+
+Within a class, ``self.m(...)`` calls propagate threads caller→callee
+and entry locks as the INTERSECTION over call sites of (caller entry
+locks ∪ locks held at the site) — a lock only counts as guarding a
+callee if EVERY path in holds it.  Nested defs are pseudo-methods of
+their enclosing method; inline closures start with no inherited locks
+(their call site, not their def site, decides what is held), spawn-
+target closures root like methods.
+
+Out of scope (documented, deliberate): reads (CPython attribute loads
+are atomic; every flagged pattern here is a write-write or write-
+reset race); container element mutation (``self.d[k] = v``); writes
+through non-self objects (``hb.stalls = 0``); TransferQueue /
+TransferMux (lock-free SPSC by design, reviewed in parallel/feed.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from tools.analyze.core import FileCtx, Reporter
+
+TARGET_CLASSES = {
+    "SketchEngine",
+    "OverloadController",
+    "FeedWorkerPool",
+    "FeedWorker",
+    "Supervisor",
+}
+
+DEVICE_PROXY_FUNCS = {"run_on_device", "submit_on_device"}
+DEVICE_PROXY_THREAD = "device-proxy"
+EXTERNAL_THREAD = "external"
+
+RUNS_ON_RE = re.compile(r"#\s*runs-on:\s*([^#]+)")
+GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*(self\.\w+)")
+THREAD_NAME_RE = re.compile(r"^[A-Za-z0-9_.-]+\*?$")
+
+
+@dataclasses.dataclass
+class Write:
+    attr: str
+    lineno: int
+    locks: frozenset[str]
+
+
+@dataclasses.dataclass
+class Method:
+    name: str  # "m" or "m.closure" for nested defs
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    public: bool
+    writes: list[Write] = dataclasses.field(default_factory=list)
+    calls: list[tuple[str, frozenset[str]]] = dataclasses.field(
+        default_factory=list)
+    runs_on: tuple[str, ...] = ()
+    # (lineno, target-method) for self.<method> value references
+    escapes: list[tuple[int, str]] = dataclasses.field(
+        default_factory=list)
+    is_property: bool = False
+
+
+def _const_str(node: ast.expr | None) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _lock_name(node: ast.expr) -> str | None:
+    """`with self._lock:` / `with lock:` context -> lock identity."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return f"self.{node.attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _ClassAnalysis:
+    def __init__(self, ctx: FileCtx, cls: ast.ClassDef, rep: Reporter):
+        self.ctx = ctx
+        self.cls = cls
+        self.rep = rep
+        self.methods: dict[str, Method] = {}
+        self.method_names: set[str] = {
+            s.name for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.guarded_by: dict[str, str] = {}  # attr -> "self._lock"
+        self.decl_lines: dict[str, int] = {}  # attr -> __init__ lineno
+        self.roots: dict[str, set[str]] = {}  # method -> thread names
+        self.is_thread_subclass = any(
+            (isinstance(b, ast.Attribute) and b.attr == "Thread")
+            or (isinstance(b, ast.Name) and b.id == "Thread")
+            for b in cls.bases
+        )
+
+    # -- annotation parsing -------------------------------------------
+    def _runs_on(self, node: ast.FunctionDef) -> tuple[str, ...]:
+        line = self.ctx.line_at(node.lineno)
+        m = RUNS_ON_RE.search(line)
+        if not m:
+            return ()
+        names = tuple(
+            t.strip() for t in m.group(1).split(",") if t.strip())
+        for t in names:
+            if not THREAD_NAME_RE.match(t):
+                self.rep.add(self.ctx, node.lineno, "RT204",
+                             f"malformed runs-on thread name {t!r}",
+                             key=f"RT204:{self.ctx.rel}:"
+                                 f"{self.cls.name}.{node.name}")
+        return tuple(t for t in names if THREAD_NAME_RE.match(t))
+
+    def _collect_init_decls(self, init: Method) -> None:
+        for node in ast.walk(init.node):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        self.decl_lines.setdefault(t.attr, node.lineno)
+                        g = GUARDED_BY_RE.search(
+                            self.ctx.line_at(node.lineno))
+                        if g:
+                            self.guarded_by[t.attr] = g.group(1)
+
+    def _add_root(self, target: str, thread: str) -> None:
+        self.roots.setdefault(target, set()).add(thread)
+
+    # -- per-method walk ----------------------------------------------
+    def _walk_method(
+        self,
+        name: str,
+        node,
+        public: bool,
+        outer_defs: dict[str, str] | None = None,
+    ) -> None:
+        meth = Method(name=name, node=node, public=public,
+                      runs_on=self._runs_on(node))
+        meth.is_property = any(
+            (isinstance(d, ast.Name) and d.id == "property")
+            or (isinstance(d, ast.Attribute)
+                and d.attr in ("cached_property", "property"))
+            for d in node.decorator_list
+        )
+        self.methods[name] = meth
+
+        call_func_ids: set[int] = set()
+        spawn_target_ids: set[int] = set()
+        # closure name -> pseudo-method name, visible to this scope
+        local_defs: dict[str, str] = dict(outer_defs or {})
+        # candidate self.<method> value references: (id, lineno, attr)
+        attr_loads: list[tuple[int, int, str]] = []
+
+        def visit(n: ast.AST, locks: list[str]) -> None:
+            if isinstance(n, ast.With):
+                inner = list(locks)
+                for item in n.items:
+                    ln = _lock_name(item.context_expr)
+                    if ln is not None:
+                        inner.append(ln)
+                for stmt in n.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                pseudo = f"{name}.{n.name}"
+                local_defs[n.name] = pseudo
+                # closures start with NO inherited locks: their call
+                # site, not their def site, decides what is held
+                self._walk_method(pseudo, n, public=False,
+                                  outer_defs=dict(local_defs))
+                return
+            if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    n.targets if isinstance(n, ast.Assign) else [n.target]
+                )
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        meth.writes.append(
+                            Write(t.attr, n.lineno, frozenset(locks)))
+            if isinstance(n, ast.Call):
+                call_func_ids.add(id(n.func))
+                self._classify_call(n, meth, frozenset(locks),
+                                    local_defs, spawn_target_ids)
+            if (isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self"
+                    and n.attr in self.method_names
+                    and isinstance(n.ctx, ast.Load)):
+                attr_loads.append((id(n), n.lineno, n.attr))
+            for child in ast.iter_child_nodes(n):
+                visit(child, locks)
+
+        for stmt in node.body:
+            visit(stmt, [])
+
+        for node_id, lineno, target in attr_loads:
+            if node_id in call_func_ids or node_id in spawn_target_ids:
+                continue
+            meth.escapes.append((lineno, target))
+
+    def _classify_call(
+        self,
+        call: ast.Call,
+        meth: Method,
+        locks: frozenset[str],
+        local_defs: dict[str, str],
+        spawn_target_ids: set[int],
+    ) -> None:
+        func = call.func
+
+        def resolve_target(node: ast.expr | None) -> str | None:
+            """Spawn-target expression -> method/pseudo name."""
+            if node is None:
+                return None
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and node.attr in self.method_names):
+                spawn_target_ids.add(id(node))
+                return node.attr
+            if isinstance(node, ast.Name) and node.id in local_defs:
+                return local_defs[node.id]
+            return None
+
+        # threading.Thread(target=..., name="...")
+        if (isinstance(func, ast.Attribute) and func.attr == "Thread"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "threading"):
+            target = tname = None
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target = resolve_target(kw.value)
+                elif kw.arg == "name":
+                    tname = _const_str(kw.value)
+            if target is not None:
+                self._add_root(target, tname or f"thread:{meth.name}")
+            return
+
+        # supervisor.spawn("name", target, ...)
+        if isinstance(func, ast.Attribute) and func.attr == "spawn":
+            tname = _const_str(call.args[0]) if call.args else None
+            tnode = call.args[1] if len(call.args) > 1 else None
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    tnode = kw.value
+                elif kw.arg == "name":
+                    tname = _const_str(kw.value)
+            target = resolve_target(tnode)
+            if target is not None:
+                self._add_root(target, tname or f"spawn:{meth.name}")
+            return
+
+        # run_on_device(fn, ...) / submit_on_device(fn, ...)
+        fname = (
+            func.id if isinstance(func, ast.Name)
+            else func.attr if isinstance(func, ast.Attribute)
+            else None
+        )
+        if fname in DEVICE_PROXY_FUNCS and call.args:
+            target = resolve_target(call.args[0])
+            if target is not None:
+                self._add_root(target, DEVICE_PROXY_THREAD)
+            return
+
+        # plain intra-class calls: self.m(...) / closure()
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and func.attr in self.method_names):
+            meth.calls.append((func.attr, locks))
+        elif isinstance(func, ast.Name) and func.id in local_defs:
+            meth.calls.append((local_defs[func.id], locks))
+
+    # -- whole-class analysis -----------------------------------------
+    def analyze(self) -> None:
+        for stmt in self.cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_method(
+                    stmt.name, stmt,
+                    public=not stmt.name.startswith("_"))
+
+        init = self.methods.get("__init__")
+        if init is not None:
+            self._collect_init_decls(init)
+
+        # property access = call on the accessor's thread; other
+        # escaping references need a runs-on declaration (RT202)
+        for meth in list(self.methods.values()):
+            if meth.name in ("__init__", "__post_init__"):
+                continue
+            for lineno, target in meth.escapes:
+                tm = self.methods.get(target)
+                if tm is not None and tm.is_property:
+                    meth.calls.append((target, frozenset()))
+                    continue
+                if tm is not None and (tm.runs_on or target in self.roots):
+                    continue  # thread declared or spawn-rooted
+                self.rep.add(
+                    self.ctx, lineno, "RT202",
+                    f"{self.cls.name}.{target} escapes as a callback "
+                    "without a `# runs-on:` annotation on its def line",
+                    key=f"RT202:{self.ctx.rel}:{self.cls.name}.{target}",
+                    also_noqa_lines=(
+                        (tm.node.lineno,) if tm is not None else ()))
+
+        # RT203: guarded-by must name a lock attribute from __init__
+        for attr, lock in sorted(self.guarded_by.items()):
+            lock_attr = lock.split(".", 1)[1]
+            if lock_attr not in self.decl_lines:
+                self.rep.add(
+                    self.ctx, self.decl_lines.get(attr, 1), "RT203",
+                    f"{self.cls.name}.{attr} guarded-by {lock} which "
+                    "is not initialized in __init__",
+                    key=f"RT203:{self.ctx.rel}:{self.cls.name}.{attr}")
+
+        # -- thread/lock fixpoint -------------------------------------
+        threads: dict[str, set[str]] = {m: set() for m in self.methods}
+        elocks: dict[str, frozenset[str] | None] = {
+            m: None for m in self.methods  # None = not yet reached
+        }
+        called = {c for m in self.methods.values() for c, _ in m.calls}
+
+        for mname, meth in self.methods.items():
+            mroots = set(self.roots.get(mname, ()))
+            if self.is_thread_subclass and mname == "run":
+                mroots.add(f"{self.cls.name}.run*")
+            mroots.update(meth.runs_on)
+            if not mroots and not meth.runs_on:
+                # default attribution: public API, or a private helper
+                # nobody in-class calls (tests / cross-class callers)
+                top_level = "." not in mname
+                if top_level and (meth.public or mname not in called):
+                    mroots.add(EXTERNAL_THREAD)
+            if mroots:
+                threads[mname] |= mroots
+                elocks[mname] = frozenset()
+
+        for _ in range(len(self.methods) + 2):
+            changed = False
+            for mname, meth in self.methods.items():
+                if elocks[mname] is None:
+                    continue
+                for callee, site_locks in meth.calls:
+                    if callee not in self.methods:
+                        continue
+                    new_t = threads[mname] - threads[callee]
+                    if new_t:
+                        threads[callee] |= new_t
+                        changed = True
+                    entry = (elocks[mname] or frozenset()) | site_locks
+                    cur = elocks[callee]
+                    nxt = entry if cur is None else (cur & entry)
+                    if nxt != cur:
+                        elocks[callee] = nxt
+                        changed = True
+            if not changed:
+                break
+
+        # -- per-attribute verdicts (construction excluded) -----------
+        per_attr: dict[str, list[tuple[str, set[str], Write]]] = {}
+        for mname, meth in self.methods.items():
+            if mname in ("__init__", "__post_init__"):
+                continue
+            base = elocks[mname] or frozenset()
+            for w in meth.writes:
+                per_attr.setdefault(w.attr, []).append(
+                    (mname, threads[mname],
+                     Write(w.attr, w.lineno, base | w.locks)))
+
+        for attr, writes in sorted(per_attr.items()):
+            decl_line = self.decl_lines.get(attr, 0)
+            guard = self.guarded_by.get(attr)
+            if guard is not None:
+                for mname, _, w in writes:
+                    if guard not in w.locks:
+                        self.rep.add(
+                            self.ctx, w.lineno, "RT201",
+                            f"write to {self.cls.name}.{attr} in "
+                            f"`{mname}` without declared guard {guard}",
+                            key=f"RT201:{self.ctx.rel}:"
+                                f"{self.cls.name}.{attr}:{mname}")
+                continue
+            all_threads: set[str] = set()
+            for _, tset, _w in writes:
+                all_threads |= tset
+            # A plural thread counts as 2 writers — EXCEPT the class's
+            # own run() pool: each instance's run thread writes that
+            # instance's attributes, so "many threads" is still one
+            # writer per object.
+            own_run = f"{self.cls.name}.run*"
+            weight = sum(
+                2 if (t.endswith("*") and t != own_run) else 1
+                for t in all_threads)
+            if weight < 2:
+                continue
+            common: frozenset[str] | None = None
+            for _, _, w in writes:
+                common = w.locks if common is None else (common & w.locks)
+            if common:
+                continue  # consistent undeclared lock discipline: safe
+            sites = ", ".join(f"{m}:{w.lineno}" for m, _, w in writes[:6])
+            self.rep.add(
+                self.ctx, writes[0][2].lineno, "RT200",
+                f"{self.cls.name}.{attr} written from threads "
+                f"{sorted(all_threads)} with no common lock ({sites}) "
+                "— add a lock + `# guarded-by:` on the __init__ "
+                "declaration, or noqa with a reason",
+                key=f"RT200:{self.ctx.rel}:{self.cls.name}.{attr}",
+                also_noqa_lines=(decl_line,) if decl_line else ())
+
+
+def check(ctx: FileCtx, rep: Reporter) -> None:
+    if "retina_tpu" not in ctx.path.parts:
+        return
+    for node in ctx.tree.body:
+        if (isinstance(node, ast.ClassDef)
+                and node.name in TARGET_CLASSES):
+            _ClassAnalysis(ctx, node, rep).analyze()

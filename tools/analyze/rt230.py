@@ -1,0 +1,172 @@
+"""RT230-RT232 — config-knob drift (whole-program).
+
+The contract: ``retina_tpu/config.py``'s ``Config`` dataclass is the
+single source of runtime knobs; every ``cfg.<attr>`` /
+``self.cfg.<attr>`` access in the agent resolves to a declared field;
+every field is actually read by the runtime and documented in
+``docs/configuration.md``:
+
+  RT230 access to a cfg attribute that is not a Config field
+        (typo'd knob reads silently as AttributeError at runtime —
+        or worse, getattr-with-default hides it forever)
+  RT231 Config field never read outside config.py (dead knob:
+        operators can set it, nothing changes)
+  RT232 Config field missing from docs/configuration.md
+
+Holders are recognized syntactically: a bare name ``cfg`` or any
+``*.cfg`` attribute chain (``self.cfg``, ``pool.cfg``) — the repo
+convention is that a binding named exactly ``cfg`` always holds the
+agent Config.  A function whose ``cfg`` parameter is annotated with a
+different type (``cfg: ShellConfig``) opts its whole body out.
+``getattr(cfg, "name", default)`` strings count as reads; keyword
+names in ``dataclasses.replace(cfg, ...)`` count too.  Tests are
+excluded from the read census: a knob only tests exercise is still a
+dead knob.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.analyze.core import FileCtx, Reporter
+
+CONFIG_REL = "retina_tpu/config.py"
+DOC_REL = "docs/configuration.md"
+
+
+def _config_class(ctx: FileCtx) -> ast.ClassDef | None:
+    for node in ctx.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            return node
+    return None
+
+
+def _fields_and_methods(
+    cls: ast.ClassDef,
+) -> tuple[dict[str, int], set[str]]:
+    fields: dict[str, int] = {}
+    methods: set[str] = set()
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name):
+            fields[stmt.target.id] = stmt.lineno
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            methods.add(stmt.name)
+    return fields, methods
+
+
+def _is_cfg_holder(node: ast.expr) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "cfg"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "cfg"
+    return False
+
+
+def check_program(ctxs: list[FileCtx], rep: Reporter, root: Path) -> None:
+    by_rel = {c.rel: c for c in ctxs}
+    cfg_ctx = by_rel.get(CONFIG_REL)
+    if cfg_ctx is None:
+        return
+    cls = _config_class(cfg_ctx)
+    if cls is None:
+        return
+    fields, methods = _fields_and_methods(cls)
+    allowed = set(fields) | methods
+
+    scan = [
+        c for c in ctxs
+        if (c.rel.startswith("retina_tpu/")
+            or c.rel in ("bench.py", "__graft_entry__.py"))
+        and c.rel != CONFIG_REL
+    ]
+
+    reads: set[str] = set()
+
+    def _foreign_cfg(fn: ast.AST) -> bool:
+        """True when `fn` declares a cfg parameter annotated with a
+        type other than Config — its body's bare-`cfg` accesses are a
+        different object (e.g. shell.py's ShellConfig)."""
+        args = getattr(fn, "args", None)
+        if args is None:
+            return False
+        for a in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+            if a.arg == "cfg" and a.annotation is not None:
+                ann = a.annotation
+                name = (
+                    ann.id if isinstance(ann, ast.Name)
+                    else ann.attr if isinstance(ann, ast.Attribute)
+                    else None
+                )
+                if name is not None and name != "Config":
+                    return True
+        return False
+
+    def _walk(ctx: FileCtx, node: ast.AST, foreign: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _walk(ctx, child, foreign or _foreign_cfg(child))
+                continue
+            _visit(ctx, child, foreign)
+            _walk(ctx, child, foreign)
+
+    def _visit(ctx: FileCtx, node: ast.AST, foreign: bool) -> None:
+        if (isinstance(node, ast.Attribute)
+                and _is_cfg_holder(node.value)):
+            if foreign and isinstance(node.value, ast.Name):
+                return
+            attr = node.attr
+            if attr.startswith("__"):
+                return
+            reads.add(attr)
+            if attr not in allowed:
+                rep.add(ctx, node.lineno, "RT230",
+                        f"cfg.{attr} is not a Config field "
+                        "(typo'd knob?)",
+                        key=f"RT230:{ctx.rel}:{attr}")
+        elif isinstance(node, ast.Call):
+            func = node.func
+            # getattr(cfg, "name"[, default])
+            if (isinstance(func, ast.Name) and func.id == "getattr"
+                    and len(node.args) >= 2
+                    and _is_cfg_holder(node.args[0])
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                attr = node.args[1].value
+                reads.add(attr)
+                if attr not in allowed and len(node.args) == 2:
+                    rep.add(ctx, node.lineno, "RT230",
+                            f'getattr(cfg, "{attr}") is not a '
+                            "Config field",
+                            key=f"RT230:{ctx.rel}:{attr}")
+            # dataclasses.replace(cfg, field=...) keyword reads
+            is_replace = (
+                (isinstance(func, ast.Attribute)
+                 and func.attr == "replace")
+                or (isinstance(func, ast.Name)
+                    and func.id == "replace")
+            )
+            if (is_replace and node.args
+                    and _is_cfg_holder(node.args[0])):
+                for kw in node.keywords:
+                    if kw.arg:
+                        reads.add(kw.arg)
+
+    for ctx in scan:
+        _walk(ctx, ctx.tree, False)
+
+    doc_path = root / DOC_REL
+    doc_text = doc_path.read_text() if doc_path.exists() else ""
+
+    for name, lineno in sorted(fields.items()):
+        if name not in reads:
+            rep.add(cfg_ctx, lineno, "RT231",
+                    f"Config.{name} is never read outside config.py "
+                    "(dead knob)",
+                    key=f"RT231:{name}")
+        if not re.search(rf"\b{re.escape(name)}\b", doc_text):
+            rep.add(cfg_ctx, lineno, "RT232",
+                    f"Config.{name} is not documented in {DOC_REL}",
+                    key=f"RT232:{name}")

@@ -1,281 +1,28 @@
 #!/usr/bin/env python3
-"""Offline static analysis for retina_tpu (no third-party linters in
-the TPU image, so this provides the high-precision subset of ruff's
-F/E9/B rules locally; CI additionally runs real ruff+mypy where pip is
-available — .github/workflows/lint.yaml).
+"""Offline static analysis for retina_tpu — thin entry point.
 
-Checks (all precise, no style opinions):
-  F401  module-level import never used (skipped in __init__.py
-        re-export surfaces and for names listed in __all__)
-  E722  bare `except:`
-  B006  mutable default argument (list/dict/set literal)
-  F541  f-string without placeholders
-  E711  comparison to None with ==/!=
-  F601  duplicate dict literal key
-  B011  assert on a non-empty tuple (always true)
-  F811  duplicate top-level def/class name
-  RT100 threading.Thread spawned in engine.py outside the sanctioned
-        helpers (start, start_background_warm, _ensure_harvest_thread,
-        _request_recovery).
-        Every engine thread must be created where shutdown joins it —
-        a thread spawned ad hoc escapes the stop/join protocol and the
-        device-proxy single-thread invariant review.
-  RT101 silent exception swallow in retina_tpu/: an `except` handler
-        whose body is only `pass`/`...` hides failures from operators.
-        Every swallow must at least log (rate-limited) and bump a
-        named error counter; a deliberate swallow carries a
-        `# noqa: RT101 — reason` on the except line.
-  RT102 unbounded stdlib queue constructed in retina_tpu/: a
-        `queue.Queue()` with no maxsize (or maxsize<=0), or a
-        `SimpleQueue()`, has no backpressure edge — under overload it
-        grows host memory without bound instead of surfacing as
-        drop-and-count/shed (docs/operations.md §6). Bounded queues
-        whose `.put()` blocks are fine: the bound IS the backpressure
-        edge. A deliberately unbounded queue carries a
-        `# noqa: RT102 — reason` on the construction line (e.g. the
-        engine harvest queue: window-cadence items, trivially small).
+The rules live in tools/analyze/ (shared driver, one parse per file,
+per-finding `# noqa: CODE — reason` suppression, reviewed baseline in
+tools/analyze/baseline.json).  Rule catalog and conventions:
+docs/static-analysis.md.  `python tools/lint.py --list-rules` prints
+the family summary.
 
-`# noqa` (with or without a code) on the flagged line suppresses it.
-Exit code 1 if any finding. Usage: python tools/lint.py [paths...]
+Usage: python tools/lint.py [paths...] [--update-baseline]
+Exit code 1 if any non-baselined finding.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-def _names_loaded(tree: ast.AST) -> set[str]:
-    used: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            # a.b.c -> root name a (covers `import a.b` usage)
-            n = node
-            while isinstance(n, ast.Attribute):
-                n = n.value
-            if isinstance(n, ast.Name):
-                used.add(n.id)
-    return used
-
-
-def _all_exports(tree: ast.Module) -> set[str]:
-    out: set[str] = set()
-    for node in tree.body:
-        if (isinstance(node, ast.Assign)
-                and any(isinstance(t, ast.Name) and t.id == "__all__"
-                        for t in node.targets)
-                and isinstance(node.value, (ast.List, ast.Tuple))):
-            for elt in node.value.elts:
-                if isinstance(elt, ast.Constant) and isinstance(
-                        elt.value, str):
-                    out.add(elt.value)
-    return out
-
-
-def check_file(path: Path) -> list[tuple[int, str, str]]:
-    src = path.read_text()
-    lines = src.splitlines()
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [(e.lineno or 0, "E999", f"syntax error: {e.msg}")]
-
-    finds: list[tuple[int, str, str]] = []
-
-    def add(lineno: int, code: str, msg: str) -> None:
-        if 0 < lineno <= len(lines) and "noqa" in lines[lineno - 1]:
-            return
-        finds.append((lineno, code, msg))
-
-    used = _names_loaded(tree)
-    exported = _all_exports(tree)
-    is_init = path.name == "__init__.py"
-
-    # F401 — only module-level imports; conftest/test fixtures excluded
-    # by the caller's path selection.
-    if not is_init:
-        for node in tree.body:
-            if isinstance(node, ast.Import):
-                for a in node.names:
-                    name = (a.asname or a.name).split(".")[0]
-                    if name not in used and name not in exported:
-                        add(node.lineno, "F401",
-                            f"`import {a.name}` unused")
-            elif isinstance(node, ast.ImportFrom):
-                if node.module == "__future__":
-                    continue
-                for a in node.names:
-                    if a.name == "*":
-                        continue
-                    name = a.asname or a.name
-                    if name not in used and name not in exported:
-                        add(node.lineno, "F401",
-                            f"`from {node.module} import {a.name}` unused")
-
-    seen_top: dict[str, int] = {}
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            if node.name in seen_top:
-                add(node.lineno, "F811",
-                    f"`{node.name}` redefines line {seen_top[node.name]}")
-            seen_top[node.name] = node.lineno
-
-    # Format specs (f"{x:.1f}") parse as JoinedStr children of
-    # FormattedValue — not user f-strings; exclude them from F541.
-    spec_ids = {
-        id(n.format_spec) for n in ast.walk(tree)
-        if isinstance(n, ast.FormattedValue) and n.format_spec is not None
-    }
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            add(node.lineno, "E722", "bare `except:`")
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for d in (*node.args.defaults, *node.args.kw_defaults):
-                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
-                    add(d.lineno, "B006", "mutable default argument")
-        elif isinstance(node, ast.JoinedStr):
-            if id(node) not in spec_ids and not any(
-                    isinstance(v, ast.FormattedValue)
-                    for v in node.values):
-                add(node.lineno, "F541", "f-string without placeholders")
-        elif isinstance(node, ast.Compare):
-            for op, comp in zip(node.ops, node.comparators):
-                if (isinstance(op, (ast.Eq, ast.NotEq))
-                        and isinstance(comp, ast.Constant)
-                        and comp.value is None):
-                    add(node.lineno, "E711",
-                        "comparison to None (use `is`/`is not`)")
-        elif isinstance(node, ast.Dict):
-            keys = [
-                k.value for k in node.keys
-                if isinstance(k, ast.Constant)
-                and isinstance(k.value, (str, int))
-            ]
-            dupes = {k for k in keys if keys.count(k) > 1}
-            if dupes:
-                add(node.lineno, "F601",
-                    f"duplicate dict key(s): {sorted(map(str, dupes))}")
-        elif isinstance(node, ast.Assert):
-            if isinstance(node.test, ast.Tuple) and node.test.elts:
-                add(node.lineno, "B011",
-                    "assert on a tuple is always true")
-
-    # RT100 — engine thread spawns outside the sanctioned helpers.
-    # The engine's threads all follow a create-here/join-at-shutdown
-    # protocol (feed loop finally block); a Thread() anywhere else in
-    # the file is a leak of that protocol until proven otherwise.
-    if path.name == "engine.py":
-        sanctioned = {
-            "start", "start_background_warm", "_ensure_harvest_thread",
-            "_request_recovery",
-        }
-
-        def _walk_fn(node: ast.AST, fn: str | None) -> None:
-            for child in ast.iter_child_nodes(node):
-                nxt = fn
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef)):
-                    # Nested defs (closures like _warm) belong to the
-                    # sanctioned outer helper that defines them.
-                    nxt = fn if fn in sanctioned else child.name
-                if (isinstance(child, ast.Call)
-                        and isinstance(child.func, ast.Attribute)
-                        and child.func.attr == "Thread"
-                        and isinstance(child.func.value, ast.Name)
-                        and child.func.value.id == "threading"
-                        and fn not in sanctioned):
-                    add(child.lineno, "RT100",
-                        "threading.Thread spawned outside sanctioned "
-                        f"engine helpers (in `{fn or '<module>'}`)")
-                _walk_fn(child, nxt)
-
-        _walk_fn(tree, None)
-
-    # RT101 — silent exception swallows in production code. Handlers
-    # whose body is only pass/... make failures invisible; the
-    # robustness contract is log-once (rate-limited) + named error
-    # counter, or an explicit noqa with a reason.
-    if "retina_tpu" in path.parts:
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
-            body_silent = all(
-                isinstance(stmt, ast.Pass)
-                or (isinstance(stmt, ast.Expr)
-                    and isinstance(stmt.value, ast.Constant)
-                    and stmt.value.value is Ellipsis)
-                for stmt in node.body
-            )
-            if body_silent:
-                add(node.lineno, "RT101",
-                    "silent exception swallow (`except ...: pass`) — "
-                    "log + count it, or noqa with a reason")
-
-    # RT102 — unbounded stdlib queues in production code. Matches the
-    # stdlib classes via `queue`/`queue_mod` attribute access or a
-    # direct `from queue import Queue` name; custom bounded queues
-    # (e.g. parallel/feed.TransferQueue) are out of scope by name.
-    if "retina_tpu" in path.parts:
-        q_classes = {"Queue", "LifoQueue", "PriorityQueue"}
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            func = node.func
-            cls = None
-            if (isinstance(func, ast.Attribute)
-                    and isinstance(func.value, ast.Name)
-                    and func.value.id in ("queue", "queue_mod")):
-                cls = func.attr
-            elif (isinstance(func, ast.Name)
-                    and func.id in (q_classes | {"SimpleQueue"})):
-                cls = func.id
-            if cls == "SimpleQueue":
-                add(node.lineno, "RT102",
-                    "SimpleQueue is always unbounded — use a bounded "
-                    "queue.Queue(maxsize) or noqa with a reason")
-                continue
-            if cls not in q_classes:
-                continue
-            size = None
-            if node.args:
-                size = node.args[0]
-            for kw in node.keywords:
-                if kw.arg == "maxsize":
-                    size = kw.value
-            unbounded = size is None or (
-                isinstance(size, ast.Constant)
-                and isinstance(size.value, int) and size.value <= 0
-            )
-            if unbounded:
-                add(node.lineno, "RT102",
-                    f"unbounded {cls}() — no backpressure edge; pass "
-                    "maxsize or noqa with a reason")
-    return finds
+from tools.analyze import driver  # noqa: E402
 
 
 def main(argv: list[str]) -> int:
-    roots = [Path(p) for p in (argv or ["retina_tpu", "tests", "tools",
-                                        "bench.py", "__graft_entry__.py"])]
-    files: list[Path] = []
-    for r in roots:
-        if r.is_dir():
-            files += sorted(r.rglob("*.py"))
-        elif r.suffix == ".py":
-            files.append(r)
-    n = 0
-    for f in files:
-        if "__pycache__" in f.parts:
-            continue
-        for lineno, code, msg in check_file(f):
-            print(f"{f}:{lineno}: {code} {msg}")
-            n += 1
-    print(f"lint: {len(files)} files, {n} finding(s)")
-    return 1 if n else 0
+    return driver.run(argv)
 
 
 if __name__ == "__main__":
