@@ -644,7 +644,11 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         # source+feed+combine+assign+server all share the host cores.
         bottleneck = "host feed path (core contention)"
     res = {
-        "events_per_sec": round(rate),
+        # HEADLINE: median over EVERY measured window, transport-stall
+        # episodes included. The stall-filtered median (below) is the
+        # harness-weather-corrected view; the honest cluster-facing
+        # number leads.
+        "events_per_sec": round(rate_unfiltered),
         "scrape_p50_ms": round(p50 * 1e3, 1),
         "scrape_p99_ms": round(p99 * 1e3, 1),
         "scrapes": len(lat),
@@ -670,9 +674,11 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         # classification comment above); the headline median runs over
         # the non-stalled windows only.
         "stalled_windows": n_stalled,
-        # Median over every window INCLUDING stalls — the honest lower
-        # bound the filtered headline must be read against.
-        "events_per_sec_unfiltered": round(rate_unfiltered),
+        # Median over the non-stalled windows only (the STALL_FLOOR
+        # classification above): what the system sustains when the
+        # harness tunnel behaves. Reported beside the unfiltered
+        # headline, never in its place.
+        "events_per_sec_filtered": round(rate),
         # Background warm: seconds from first traffic to full grid
         # residency (None = did not finish inside the 600s cap).
         "bucket_warm_s": (
@@ -708,8 +714,8 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
             min(8e9 / max(wire_bpe, 1e-9), host_path_rate)
         ),
     }
-    log(f"e2e: {rate / 1e6:.2f}M ev/s sustained "
-        f"({rate_unfiltered / 1e6:.2f}M unfiltered, "
+    log(f"e2e: {rate_unfiltered / 1e6:.2f}M ev/s sustained "
+        f"({rate / 1e6:.2f}M stall-filtered, "
         f"{n_stalled} stalled windows), scrape p50 "
         f"{res['scrape_p50_ms']}ms p99 {res['scrape_p99_ms']}ms, "
         f"{wire_bpe:.1f} wire B/ev, link {link_mbs:.0f} MB/s")
@@ -765,9 +771,33 @@ def main() -> None:
     ap.add_argument("--perf", action="store_true",
                     help="agent-overhead regression harness (loopback "
                          "workload with vs without the live agent)")
+    ap.add_argument("--fleet-dryrun", action="store_true",
+                    help="multi-agent fleet rollup dryrun: 8 simulated "
+                         "node agents ship sketch snapshots to one "
+                         "aggregator; one is killed mid-run")
     args = ap.parse_args()
     try:
-        if args.perf:
+        if args.fleet_dryrun:
+            from retina_tpu.fleet.dryrun import run_dryrun
+
+            res = run_dryrun(
+                nodes=8,
+                epochs=3 if args.smoke else 6,
+                kill_after=1 if args.smoke else 3,
+                log=log,
+            )
+            out = {
+                # North star: cluster top-k recall vs exact merged
+                # counts must hold at >= 0.95 THROUGH a node dropout.
+                "metric": "fleet_topk_recall",
+                "value": res["recall_min"],
+                "unit": "recall",
+                "vs_baseline": round(res["recall_min"] / 0.95, 4),
+                "extra": res,
+            }
+            if not res["ok"]:
+                out["error"] = "fleet dryrun acceptance failed"
+        elif args.perf:
             from retina_tpu.config import (
                 DEFAULT_CACHE_DIR, enable_compilation_cache,
             )

@@ -256,6 +256,41 @@ class Config:
         default_factory=lambda: ["dns", "conntrack", "labels"]
     )
 
+    # --- fleet rollup tier (fleet/) ---
+    # Node side: ship the window-close sketch export over the relay.
+    fleet_enabled: bool = False
+    # Operator side: run the FleetAggregator (epoch-aligned merge +
+    # fleet_* metric families). Both may be on in one process (the
+    # in-process pubsub transport loops back).
+    fleet_aggregator: bool = False
+    fleet_node_name: str = ""  # wire identity ("" = node_name or pid)
+    fleet_tenant: str = "default"
+    # Higher priority tenants are shed LAST by the cardinality
+    # guardrails (PSketch-style priority awareness).
+    fleet_priority: int = 0
+    # gRPC Ship target ("host:port"); "" ships over the in-process bus.
+    fleet_relay_addr: str = ""
+    # Close an epoch as soon as this many nodes reported; 0 = close on
+    # the straggler timeout only.
+    fleet_expected_nodes: int = 0
+    # Epoch close deadline measured from the FIRST arrival — a dead
+    # node delays the rollup at most this long, never forever.
+    fleet_straggler_timeout_s: float = 2.0
+    # Max open (unclosed) epochs buffered before the oldest is
+    # force-closed: bounds aggregator memory under clock skew.
+    fleet_epoch_history: int = 8
+    # Node-side ship queue depth; a full queue drops the snapshot
+    # (never blocks the window close).
+    fleet_ship_queue: int = 4
+    # Under SHEDDING and above, ship only 1 window in this many.
+    fleet_shed_ship_every: int = 4
+    fleet_topk_k: int = 32  # cluster-wide heavy-hitter series cap
+    fleet_service_top: int = 16  # per-service cardinality series cap
+    # Per-tenant exported-series cap (the label-space guardrail).
+    fleet_tenant_series_max: int = 64
+    # Max tenants exported per epoch; lowest-priority shed first.
+    fleet_max_tenants: int = 16
+
     # --- pipeline shapes (jit keys; see models/pipeline.py) ---
     n_pods: int = 1 << 12
     cms_width: int = 1 << 15
@@ -346,6 +381,23 @@ class Config:
         from retina_tpu.runtime.overload import validate_shed_order
 
         validate_shed_order(self.overload_shed_order)
+        if self.fleet_straggler_timeout_s <= 0:
+            raise ValueError(
+                f"fleet_straggler_timeout_s must be > 0, "
+                f"got {self.fleet_straggler_timeout_s}"
+            )
+        for f in ("fleet_epoch_history", "fleet_ship_queue",
+                  "fleet_shed_ship_every", "fleet_topk_k",
+                  "fleet_service_top", "fleet_tenant_series_max"):
+            if getattr(self, f) < 1:
+                raise ValueError(
+                    f"{f} must be >= 1, got {getattr(self, f)}"
+                )
+        for f in ("fleet_expected_nodes", "fleet_max_tenants"):
+            if getattr(self, f) < 0:
+                raise ValueError(
+                    f"{f} must be >= 0, got {getattr(self, f)}"
+                )
 
 
 _BOOL_TRUE = {"1", "true", "yes", "on"}

@@ -90,6 +90,17 @@ class Daemon:
         self._mm_thread: Optional[threading.Thread] = None
         self.hubble = None
         self.monitoragent = None
+        # Fleet rollup tier (fleet/): the aggregator role is explicit
+        # config, not inferred — one operator-side process merges the
+        # cluster's shipped sketch snapshots. Built before the relay so
+        # the relay can front its ingest (retina.Fleet/Ship).
+        self.fleet_aggregator = None
+        if cfg.fleet_aggregator:
+            from retina_tpu.fleet import FleetAggregator
+
+            self.fleet_aggregator = FleetAggregator(
+                cfg, supervisor=self.cm.supervisor
+            )
         if cfg.enable_hubble:
             # Hubble CP rides alongside (cmd/hubble cell graph analog):
             # plugins mirror events into the external channel; the monitor
@@ -137,6 +148,10 @@ class Daemon:
                 tls_key=cfg.hubble_tls_key,
                 tls_client_ca=cfg.hubble_tls_client_ca,
                 unix_socket=cfg.hubble_sock_path,
+                fleet_ingest=(
+                    self.fleet_aggregator.ingest
+                    if self.fleet_aggregator is not None else None
+                ),
             )
             self.hubble_metrics_server = None
             if cfg.hubble_metrics_addr:
@@ -244,6 +259,8 @@ class Daemon:
             )
         if self.monitoragent is not None:
             self.monitoragent.start(stop)
+        if self.fleet_aggregator is not None:
+            self.fleet_aggregator.start()
         if self.hubble is not None:
             self.hubble.start()
             if getattr(self, "hubble_metrics_server", None) is not None:
@@ -289,6 +306,8 @@ class Daemon:
                 self.hubble.stop()
                 if getattr(self, "hubble_metrics_server", None) is not None:
                     self.hubble_metrics_server.stop()
+            if self.fleet_aggregator is not None:
+                self.fleet_aggregator.stop()
 
 
 def run_agent(

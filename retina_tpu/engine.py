@@ -33,6 +33,7 @@ import numpy as np
 
 from retina_tpu.config import Config
 from retina_tpu.events.schema import F, NUM_FIELDS
+from retina_tpu.fleet.shipper import window_epoch as fleet_epoch
 from retina_tpu.log import logger, rate_limited
 from retina_tpu.metrics import get_metrics
 from retina_tpu.models.identity import HostIdentityTable, IdentityMap
@@ -255,6 +256,18 @@ class SketchEngine:
         # signals; feed workers sample through it, plugins consult
         # shed_active before enrichment work.
         self._overload = OverloadController(cfg, self._overload_signals)
+        # Fleet rollup tier (fleet/): ship the device-merged sketch
+        # snapshot at every window close instead of raw samples. The
+        # shipper owns its worker thread (start()/stop() track the
+        # engine run loop); offer() on the proxy never blocks the close
+        # path, and the SHEDDING backoff consults the same controller.
+        self._fleet_shipper: Any = None
+        if cfg.fleet_enabled:
+            from retina_tpu.fleet.shipper import SnapshotShipper
+
+            self._fleet_shipper = SnapshotShipper(
+                cfg, overload=self._overload, supervisor=self._supervisor
+            )
         # Protected close lane: window ticks acquire THIS semaphore,
         # never the step in-flight one — a saturated step pipeline can
         # delay a close behind queued transfers but can never starve it
@@ -1860,6 +1873,25 @@ class SketchEngine:
         def close():
             self._device_consts()
             with self._state_lock:
+                if self._fleet_shipper is not None:
+                    # Fleet export MUST dispatch before end_window:
+                    # end_window resets the entropy window and donates
+                    # the state buffers, so this is the last moment the
+                    # closing window's sketches exist on device. Pure
+                    # dispatch — the shipper worker does the blocking
+                    # readback off the proxy; offer() never blocks.
+                    try:
+                        export = self.sharded.fleet_export(self.state)
+                        self._fleet_shipper.offer(
+                            fleet_epoch(self.cfg.window_seconds),
+                            export,
+                            self.cfg.window_seconds,
+                            self.sharded.fleet_seeds(self.state),
+                        )
+                    except Exception:
+                        get_metrics().fleet_ship_errors.inc()
+                        if self._count_error("fleet_export"):
+                            self.log.exception("fleet export failed")
                 self.state, win = self.sharded.end_window(
                     self.state, self._zthresh
                 )
@@ -2090,6 +2122,8 @@ class SketchEngine:
         blocking edge (backpressure then reaches the bounded sink, which
         drops and counts — never the producers)."""
         self.started.set()
+        if self._fleet_shipper is not None:
+            self._fleet_shipper.start()
         cap = self.cfg.batch_capacity * self.n_devices
         # Flush threshold: accumulating beyond one device batch raises the
         # combine ratio (more duplicate descriptors per pass); the
@@ -2360,6 +2394,11 @@ class SketchEngine:
             if ht is not None:
                 self._harvest_q.put(None)
                 ht.join(timeout=5.0)
+            # Stop the fleet shipper AFTER the fence: the final close's
+            # export is already queued by then, so the last window still
+            # ships before the worker parks.
+            if self._fleet_shipper is not None:
+                self._fleet_shipper.stop()
 
     # -- scrape-time readout -----------------------------------------
     def snapshot(self, max_age_s: float = 0.5) -> dict[str, Any]:

@@ -1,0 +1,16 @@
+"""Fleet rollup tier: cluster-wide sketch aggregation over the relay.
+
+Node agents ship compact, versioned sketch snapshots (NOT raw samples)
+at every window close; an operator-level aggregator aligns them by
+window epoch, merges them on device with batched psum-style reductions,
+and publishes cluster-wide heavy hitters, per-service cardinality, and
+DDoS entropy under the ``fleet_*`` Prometheus families — with
+per-tenant cardinality guardrails (docs/fleet.md).
+"""
+
+from retina_tpu.fleet.codec import (  # noqa: F401
+    FLEET_TOPIC, ROLLUP_TOPIC, FleetDecodeError, FleetSnapshot,
+    decode_snapshot, encode_snapshot,
+)
+from retina_tpu.fleet.shipper import SnapshotShipper  # noqa: F401
+from retina_tpu.fleet.aggregator import FleetAggregator  # noqa: F401

@@ -1,0 +1,482 @@
+"""Operator-side fleet aggregator: epoch alignment + on-device merge.
+
+Ingests wire frames (fleet/codec.py) from N node agents, buckets them
+by window epoch, and closes an epoch when either every expected node
+has reported (``fleet_expected_nodes``) or the straggler timeout
+expires after the FIRST arrival (``fleet_straggler_timeout_s``) — the
+rollup never blocks on a dead node. Duplicates (same node+epoch) and
+late frames (epoch at or below the watermark) are counted and dropped;
+the watermark only moves forward.
+
+The merge itself runs on device as ONE jitted batched reduction over
+the stacked per-node arrays — sum for CM tables / entropy histograms /
+totals (psum-style), max for HLL register banks, and a join-semilattice
+fold for the heavy-hitter candidate tables (ops/topk.py). Cluster
+heavy-hitter counts are then the merged CMS queried at the UNION of
+every node's candidates: a key whose traffic splits across nodes is
+undercounted in any single candidate table but exact (up to CMS error)
+in the summed tables.
+
+Published families (docs/metrics.md): cluster-wide top flows,
+per-tenant top flows, per-service cardinality, DDoS entropy, distinct
+flows — all ``fleet_*``. Label-space growth is bounded by construction:
+keyed gauges are cleared and re-published each epoch, capped at
+``fleet_topk_k`` cluster series plus ``fleet_tenant_series_max`` series
+per tenant across at most ``fleet_max_tenants`` tenants; when over
+budget the LOWEST-priority tenants are shed first (PSketch-style
+priority awareness, PAPERS.md) and the shed is counted.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.fleet.codec import (
+    ROLLUP_TOPIC, FleetDecodeError, FleetSnapshot, decode_snapshot,
+)
+from retina_tpu.log import logger, rate_limited
+from retina_tpu.metrics import get_metrics
+from retina_tpu.ops.countmin import CountMinSketch
+from retina_tpu.ops.entropy import EntropyWindow
+from retina_tpu.ops.hyperloglog import HyperLogLog
+from retina_tpu.ops.topk import TopKTable
+from retina_tpu.pubsub import get_pubsub
+
+ENTROPY_DIMS = ("src_ip", "dst_ip", "dst_port")
+_HH_FAMILIES = ("flow", "svc", "dns")
+
+
+def format_key(row: np.ndarray) -> str:
+    """Stable label rendering of one candidate key row (C u32 columns)."""
+    return "-".join(f"{int(c):08x}" for c in row)
+
+
+class _EpochBucket:
+    """Snapshots collected for one not-yet-closed epoch."""
+
+    __slots__ = ("snaps", "first_t")
+
+    def __init__(self, now: float) -> None:
+        self.snaps: dict[str, FleetSnapshot] = {}
+        self.first_t = now
+
+
+class FleetAggregator:
+    """Thread-safe; ``ingest`` runs on transport threads (pubsub pool /
+    gRPC handlers), ``poll`` on the internal timer thread."""
+
+    def __init__(self, cfg, supervisor=None) -> None:
+        self.cfg = cfg
+        self.log = logger("fleet.agg")
+        self._supervisor = supervisor
+        self._lock = threading.Lock()
+        self._buckets: dict[int, _EpochBucket] = {}
+        self._watermark = -1  # highest CLOSED epoch
+        self._ref_seeds: dict[str, int] | None = None
+        self._ref_shapes: dict[str, tuple] | None = None
+        # jitted batched-merge executables keyed by (n_nodes, array
+        # signature): re-lowering per epoch would dominate the merge.
+        self._merge_cache: dict[Any, Any] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._sub_id: str | None = None
+        # Rolling window of recent rollups for tests/dryrun/debug vars.
+        self.rollups: list[dict] = []
+        self.epochs_merged = 0
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self, subscribe: bool = True) -> None:
+        """Start the straggler-poll thread; optionally subscribe to the
+        in-process FLEET_TOPIC (the co-located transport)."""
+        if subscribe and self._sub_id is None:
+            from retina_tpu.fleet.codec import FLEET_TOPIC
+
+            self._sub_id = get_pubsub().subscribe(FLEET_TOPIC, self.ingest)
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._poll_loop, name="fleet-agg", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._sub_id is not None:
+            from retina_tpu.fleet.codec import FLEET_TOPIC
+
+            try:
+                get_pubsub().unsubscribe(FLEET_TOPIC, self._sub_id)
+            except KeyError:  # noqa: RT101 — already unsubscribed; stop is idempotent
+                pass
+            self._sub_id = None
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+            if self._supervisor is not None:
+                self._supervisor.deregister("fleet-agg")
+        self._thread = None
+
+    def _poll_loop(self) -> None:  # runs-on: fleet-agg
+        hb = None
+        if self._supervisor is not None:
+            hb = self._supervisor.register(
+                "fleet-agg", self.cfg.watchdog_deadline_s
+            )
+        cadence = max(0.05, self.cfg.fleet_straggler_timeout_s / 4.0)
+        while not self._stop.is_set():
+            if hb is not None:
+                hb.beat()
+            try:
+                self.poll()
+            except Exception:
+                get_metrics().fleet_merge_errors.inc()
+                if rate_limited("fleet.poll"):
+                    self.log.exception("fleet poll failed")
+            if hb is not None:
+                hb.park()
+            self._stop.wait(cadence)
+
+    # -- ingest --------------------------------------------------------
+    def ingest(self, frame: bytes) -> bool:  # runs-on: pubsub*, grpc*
+        """Decode + bucket one wire frame. Returns True when accepted."""
+        m = get_metrics()
+        try:
+            snap = decode_snapshot(frame)
+        except FleetDecodeError as e:
+            m.fleet_snapshots_dropped.labels(reason="decode").inc()
+            if rate_limited("fleet.decode"):
+                self.log.warning("fleet frame rejected: %s", e)
+            return False
+        ready = None
+        with self._lock:
+            if snap.epoch <= self._watermark:
+                m.fleet_snapshots_dropped.labels(reason="late").inc()
+                return False
+            if self._ref_seeds is None:
+                self._ref_seeds = dict(snap.seeds)
+                self._ref_shapes = {
+                    k: v.shape for k, v in snap.arrays.items()
+                }
+            if snap.seeds != self._ref_seeds:
+                m.fleet_snapshots_dropped.labels(
+                    reason="seed_mismatch"
+                ).inc()
+                return False
+            shapes = {k: v.shape for k, v in snap.arrays.items()}
+            if shapes != self._ref_shapes:
+                m.fleet_snapshots_dropped.labels(
+                    reason="shape_mismatch"
+                ).inc()
+                return False
+            bucket = self._buckets.get(snap.epoch)
+            if bucket is None:
+                bucket = self._buckets[snap.epoch] = _EpochBucket(
+                    time.monotonic()
+                )
+            if snap.node in bucket.snaps:
+                m.fleet_snapshots_dropped.labels(reason="duplicate").inc()
+                return False
+            bucket.snaps[snap.node] = snap
+            m.fleet_snapshots_received.labels(node=snap.node).inc()
+            expected = int(self.cfg.fleet_expected_nodes)
+            if expected > 0 and len(bucket.snaps) >= expected:
+                ready = [(snap.epoch, self._buckets.pop(snap.epoch))]
+            else:
+                ready = self._overflow_locked()
+        for epoch, b in ready or ():
+            try:
+                self._merge_epoch(epoch, b, straggled=False)
+            except Exception:
+                m.fleet_merge_errors.inc()
+                if rate_limited("fleet.merge"):
+                    self.log.exception("fleet merge failed (epoch %d)", epoch)
+        return True
+
+    def _overflow_locked(self) -> list[tuple[int, _EpochBucket]]:
+        """Bound open-epoch memory: keep at most fleet_epoch_history
+        buckets, force-closing the oldest (counts as straggled)."""
+        out = []
+        limit = max(1, int(self.cfg.fleet_epoch_history))
+        while len(self._buckets) > limit:
+            oldest = min(self._buckets)
+            out.append((oldest, self._buckets.pop(oldest)))
+        return out
+
+    def poll(self, now: float | None = None) -> int:
+        """Close epochs whose straggler timeout has expired. Returns the
+        number of epochs merged."""
+        now = time.monotonic() if now is None else now
+        timeout = self.cfg.fleet_straggler_timeout_s
+        ready: list[tuple[int, _EpochBucket]] = []
+        with self._lock:
+            for epoch in sorted(self._buckets):
+                if now - self._buckets[epoch].first_t >= timeout:
+                    ready.append((epoch, self._buckets.pop(epoch)))
+        for epoch, bucket in ready:
+            try:
+                self._merge_epoch(epoch, bucket, straggled=True)
+            except Exception:
+                get_metrics().fleet_merge_errors.inc()
+                if rate_limited("fleet.merge"):
+                    self.log.exception("fleet merge failed (epoch %d)", epoch)
+        return len(ready)
+
+    # -- merge ---------------------------------------------------------
+    def _merge_fn(self, n: int, seeds: dict[str, int], names: tuple):
+        key = (n, names, tuple(sorted(seeds.items())))
+        fn = self._merge_cache.get(key)
+        if fn is not None:
+            return fn
+
+        def merge(stacked: dict[str, jnp.ndarray]) -> dict[str, Any]:
+            out: dict[str, Any] = {}
+            for name in names:
+                arr = stacked[name]
+                if name.startswith("hll_"):
+                    out[name] = jnp.max(arr, axis=0)
+                elif name.endswith("_keys") or name.endswith("_counts"):
+                    continue  # folded below as (keys, counts) pairs
+                else:
+                    out[name] = jnp.sum(arr, axis=0)
+            for fam in _HH_FAMILIES:
+                kname, cname = f"{fam}_keys", f"{fam}_counts"
+                if kname not in stacked:  # noqa: RT212 — dict-key test, static per jit cache key
+                    continue
+                seed = int(seeds.get(fam, 0))
+                t = TopKTable(
+                    stacked[kname][0], stacked[cname][0], seed=seed
+                )
+                for i in range(1, n):
+                    t = t.merge(TopKTable(
+                        stacked[kname][i], stacked[cname][i], seed=seed,
+                    ))
+                out[kname], out[cname] = t.key_rows, t.counts
+            return out
+
+        fn = jax.jit(merge)
+        self._merge_cache[key] = fn
+        return fn
+
+    def _merge_epoch(
+        self, epoch: int, bucket: _EpochBucket, straggled: bool
+    ) -> None:
+        t0 = time.monotonic()
+        m = get_metrics()
+        snaps = sorted(bucket.snaps.values(), key=lambda s: s.node)
+        if not snaps:
+            return
+        with self._lock:
+            self._watermark = max(self._watermark, epoch)
+        names = sorted(
+            set.intersection(*(set(s.arrays) for s in snaps))
+        )
+        stacked = {
+            name: jnp.asarray(
+                np.stack([s.arrays[name] for s in snaps])
+            )
+            for name in names
+        }
+        seeds = snaps[0].seeds
+        merged = self._merge_fn(len(snaps), seeds, tuple(names))(stacked)
+        rollup = self._rollup(epoch, snaps, merged, seeds)
+        rollup["straggled"] = straggled
+        rollup["merge_seconds"] = time.monotonic() - t0
+        self._publish(rollup)
+        m.fleet_windows_merged.inc()
+        if straggled:
+            m.fleet_windows_stragglers.inc()
+        m.fleet_merge_seconds.set(rollup["merge_seconds"])
+        with self._lock:
+            self.epochs_merged += 1
+            self.rollups.append(rollup)
+            del self.rollups[:-64]
+
+    # -- rollup computation -------------------------------------------
+    def _cluster_topk(
+        self,
+        fam: str,
+        snaps: list[FleetSnapshot],
+        merged: dict[str, Any],
+        seeds: dict[str, int],
+        k: int,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Top-k of the union of every node's candidates, counted by the
+        summed CMS (exact cross-node totals up to CMS overestimate)."""
+        cand = []
+        for s in snaps:
+            keys = s.arrays.get(f"{fam}_keys")
+            counts = s.arrays.get(f"{fam}_counts")
+            if keys is None or counts is None:
+                continue
+            cand.append(keys[counts > 0])
+        if not cand:
+            return np.zeros((0, 0), np.uint32), np.zeros((0,), np.uint64)
+        union = np.unique(np.concatenate(cand, axis=0), axis=0)
+        if not len(union):
+            return union, np.zeros((0,), np.uint64)
+        cms = CountMinSketch(
+            table=merged[f"{fam}_cms"],
+            seed=int(seeds.get(fam, 0)),
+        )
+        key_cols = [jnp.asarray(union[:, c]) for c in range(union.shape[1])]
+        est = np.asarray(cms.query(key_cols)).astype(np.uint64)
+        order = np.argsort(est)[::-1][:k]
+        sel = est[order] > 0
+        return union[order][sel], est[order][sel]
+
+    def _rollup(
+        self,
+        epoch: int,
+        snaps: list[FleetSnapshot],
+        merged: dict[str, Any],
+        seeds: dict[str, int],
+    ) -> dict:
+        cfg = self.cfg
+        k = int(cfg.fleet_topk_k)
+        rollup: dict[str, Any] = {
+            "epoch": epoch,
+            "nodes": [s.node for s in snaps],
+            "window_s": snaps[0].window_s,
+        }
+        # Cluster-wide heavy hitters per family.
+        for fam in _HH_FAMILIES:
+            if f"{fam}_cms" not in merged:
+                continue
+            keys, counts = self._cluster_topk(fam, snaps, merged, seeds, k)
+            rollup[f"top_{fam}"] = (keys, counts)
+        # Per-service (per-pod) distinct-source cardinality.
+        if "hll_src_per_pod" in merged:
+            hll = HyperLogLog(
+                registers=merged["hll_src_per_pod"],
+                seed=int(seeds.get("hll_src_per_pod", 0)),
+            )
+            est = np.asarray(hll.estimate())
+            top = np.argsort(est)[::-1][: int(cfg.fleet_service_top)]
+            rollup["service_cardinality"] = [
+                (int(i), float(est[i])) for i in top if est[i] >= 1.0
+            ]
+        if "hll_flows" in merged:
+            hll = HyperLogLog(
+                registers=merged["hll_flows"],
+                seed=int(seeds.get("hll_flows", 0)),
+            )
+            rollup["distinct_flows"] = float(np.asarray(hll.estimate())[0])
+        # Cluster DDoS entropy of the merged histograms: exactly the
+        # single-node estimate of the union stream (ops/entropy.py).
+        if "entropy" in merged:
+            ent = EntropyWindow(
+                counts=merged["entropy"],
+                seed=int(seeds.get("entropy", 0)),
+            )
+            bits = np.asarray(ent.entropy_bits())
+            rollup["entropy_bits"] = {
+                dim: float(bits[i])
+                for i, dim in enumerate(ENTROPY_DIMS)
+                if i < len(bits)
+            }
+        if "totals" in merged:
+            rollup["totals"] = np.asarray(merged["totals"])
+        # Per-tenant heavy hitters under the cardinality guardrails.
+        rollup["tenants"] = self._tenant_rollups(snaps, seeds)
+        return rollup
+
+    def _tenant_rollups(
+        self, snaps: list[FleetSnapshot], seeds: dict[str, int]
+    ) -> dict[str, dict]:
+        """Per-tenant flow top-k with the label-space guardrails: at
+        most ``fleet_max_tenants`` tenants (lowest priority shed first),
+        at most ``fleet_tenant_series_max`` series each."""
+        cfg = self.cfg
+        m = get_metrics()
+        by_tenant: dict[str, list[FleetSnapshot]] = {}
+        prio: dict[str, int] = {}
+        for s in snaps:
+            by_tenant.setdefault(s.tenant, []).append(s)
+            prio[s.tenant] = max(prio.get(s.tenant, s.priority), s.priority)
+        ranked = sorted(by_tenant, key=lambda t: (-prio[t], t))
+        kept = ranked[: max(0, int(cfg.fleet_max_tenants))]
+        for t in ranked[len(kept):]:
+            m.fleet_tenants_shed.inc()
+            if rate_limited("fleet.tenant_shed"):
+                self.log.warning(
+                    "fleet: tenant %s shed (priority %d, budget %d)",
+                    t, prio[t], cfg.fleet_max_tenants,
+                )
+        cap = max(1, int(cfg.fleet_tenant_series_max))
+        out: dict[str, dict] = {}
+        for tenant in kept:
+            group = by_tenant[tenant]
+            tables = [
+                s.arrays["flow_cms"] for s in group
+                if "flow_cms" in s.arrays
+            ]
+            if not tables:
+                continue
+            merged_cms = {
+                "flow_cms": jnp.sum(
+                    jnp.asarray(np.stack(tables)), axis=0
+                )
+            }
+            keys, counts = self._cluster_topk(
+                "flow", group, merged_cms, seeds,
+                min(int(cfg.fleet_topk_k), cap),
+            )
+            if len(keys) > cap:  # defense in depth; min() above caps
+                m.fleet_series_capped.inc(len(keys) - cap)
+                keys, counts = keys[:cap], counts[:cap]
+            out[tenant] = {
+                "priority": prio[tenant],
+                "top_flows": (keys, counts),
+                "nodes": [s.node for s in group],
+            }
+        return out
+
+    # -- publication ---------------------------------------------------
+    def _publish(self, rollup: dict) -> None:
+        m = get_metrics()
+        m.fleet_nodes_reporting.set(len(rollup["nodes"]))
+        # Keyed gauges: clear-and-republish each epoch so the exported
+        # label space never exceeds this epoch's (capped) series set —
+        # the guardrail is structural, not advisory.
+        m.fleet_top_flows.clear()
+        m.fleet_tenant_top_flows.clear()
+        m.fleet_service_cardinality.clear()
+        m.fleet_tenant_series.clear()
+        for fam, gauge in (("flow", m.fleet_top_flows),):
+            pair = rollup.get(f"top_{fam}")
+            if pair is None:
+                continue
+            keys, counts = pair
+            for row, count in zip(keys, counts):
+                gauge.labels(key=format_key(row)).set(float(count))
+        for idx, est in rollup.get("service_cardinality", ()):
+            m.fleet_service_cardinality.labels(service=f"pod{idx}").set(est)
+        for dim, bits in rollup.get("entropy_bits", {}).items():
+            m.fleet_entropy_bits.labels(dimension=dim).set(bits)
+        if "distinct_flows" in rollup:
+            m.fleet_distinct_flows.set(rollup["distinct_flows"])
+        for tenant, tr in rollup["tenants"].items():
+            keys, counts = tr["top_flows"]
+            for row, count in zip(keys, counts):
+                m.fleet_tenant_top_flows.labels(
+                    tenant=tenant, key=format_key(row)
+                ).set(float(count))
+            m.fleet_tenant_series.labels(tenant=tenant).set(len(keys))
+        get_pubsub().publish(ROLLUP_TOPIC, rollup)
+
+    # -- observability -------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "watermark": self._watermark,
+                "open_epochs": sorted(self._buckets),
+                "epochs_merged": self.epochs_merged,
+                "nodes_last": (
+                    self.rollups[-1]["nodes"] if self.rollups else []
+                ),
+            }

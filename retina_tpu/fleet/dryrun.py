@@ -1,0 +1,276 @@
+"""Multi-agent fleet rollup dryrun (``bench.py --fleet-dryrun``).
+
+Simulates N node agents in ONE process: each agent thread owns real
+sketch objects (ops/), a real :class:`SnapshotShipper`, and ships real
+RFLT frames over the in-process pubsub bus to one
+:class:`FleetAggregator` — the full wire path minus the engines and the
+gRPC hop. Exact per-flow ground-truth counts ride alongside, so the run
+scores cluster top-k recall against the exact merged counts of the
+nodes each rollup actually merged (late/dead nodes excluded on BOTH
+sides — the acceptance contract is "unaffected beyond the dropped
+share").
+
+One agent is killed mid-run (``kill_after``): epochs after the kill
+must still close via the straggler timeout, never blocking on the dead
+node.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import Counter
+from typing import Any, Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.config import Config
+from retina_tpu.fleet.aggregator import FleetAggregator
+from retina_tpu.fleet.shipper import SnapshotShipper
+from retina_tpu.ops.entropy import EntropyWindow
+from retina_tpu.ops.hyperloglog import HyperLogLog
+from retina_tpu.ops.topk import HeavyHitterSketch
+
+# Sketch shapes for the simulated agents: small enough that 8+ agents
+# build a window in milliseconds, wide enough that CMS noise stays far
+# below the heavy/light weight separation.
+_SLOTS = 1 << 10
+_WIDTH = 1 << 12
+_DEPTH = 4
+_PODS = 16
+
+SEEDS = {
+    "flow": 1, "svc": 2, "dns": 3,
+    "hll_flows": 4, "hll_src_per_pod": 6, "entropy": 7,
+}
+
+
+def _sketch_arrays(keys: np.ndarray, w: np.ndarray) -> dict[str, np.ndarray]:
+    """One node-window's wire arrays from (B, 4) uint32 keys + integer
+    weights — the same array catalog the engine's fleet_export emits."""
+    b = keys.shape[0]
+    cols = [jnp.asarray(keys[:, i]) for i in range(4)]
+    wv = jnp.asarray(w, jnp.float32)
+    ones = jnp.ones((b,), jnp.float32)
+    g0 = jnp.zeros((b,), jnp.int32)
+    flow = HeavyHitterSketch.zeros(
+        4, depth=_DEPTH, width=_WIDTH, n_slots=_SLOTS, seed=SEEDS["flow"]
+    ).update(cols, wv)
+    svc = HeavyHitterSketch.zeros(
+        2, depth=_DEPTH, width=_WIDTH, n_slots=_SLOTS, seed=SEEDS["svc"]
+    ).update(cols[:2], wv)
+    dns = HeavyHitterSketch.zeros(
+        1, depth=_DEPTH, width=_WIDTH, n_slots=_SLOTS, seed=SEEDS["dns"]
+    ).update([cols[3]], wv)
+    hllf = HyperLogLog.zeros(1, 10, seed=SEEDS["hll_flows"]).update(
+        cols, g0, ones
+    )
+    pods = jnp.asarray(keys[:, 1] % np.uint32(_PODS), jnp.int32)
+    hllp = HyperLogLog.zeros(
+        _PODS, 6, seed=SEEDS["hll_src_per_pod"]
+    ).update([cols[0]], pods, ones)
+    ent = EntropyWindow.zeros(3, 1 << 10, seed=SEEDS["entropy"])
+    for g, c in enumerate((cols[0], cols[1], cols[3])):
+        ent = ent.update([c], jnp.full((b,), g, jnp.int32), wv)
+    totals = np.zeros(8, np.uint32)
+    totals[0] = np.uint32(min(int(w.sum()), 0xFFFFFFFF))
+    return {
+        "flow_cms": np.asarray(flow.cms.table),
+        "flow_keys": np.asarray(flow.table.key_rows),
+        "flow_counts": np.asarray(flow.table.counts),
+        "svc_cms": np.asarray(svc.cms.table),
+        "svc_keys": np.asarray(svc.table.key_rows),
+        "svc_counts": np.asarray(svc.table.counts),
+        "dns_cms": np.asarray(dns.cms.table),
+        "dns_keys": np.asarray(dns.table.key_rows),
+        "dns_counts": np.asarray(dns.table.counts),
+        "hll_flows": np.asarray(hllf.registers),
+        "hll_src_per_pod": np.asarray(hllp.registers),
+        "entropy": np.asarray(ent.counts),
+        "totals": totals,
+    }
+
+
+def run_dryrun(
+    nodes: int = 8,
+    epochs: int = 5,
+    kill_after: int = 2,
+    heavy_flows: int = 40,
+    light_flows: int = 192,
+    seed: int = 0,
+    straggler_timeout_s: float = 1.0,
+    log: Callable[[str], None] = lambda s: None,
+) -> dict[str, Any]:
+    """Run the simulation; returns the scorecard dict (see module doc).
+
+    ``kill_after``: the last agent stops shipping after this many epochs
+    (node-dropout chaos); epochs 0..kill_after-1 close on full quorum.
+    """
+    assert nodes >= 2 and epochs >= 1
+    rng = np.random.default_rng(seed)
+    base = Config(
+        fleet_enabled=True,
+        fleet_aggregator=True,
+        fleet_expected_nodes=nodes,
+        fleet_straggler_timeout_s=straggler_timeout_s,
+        fleet_topk_k=32,
+        fleet_max_tenants=4,
+        fleet_tenant_series_max=8,
+    )
+    k = base.fleet_topk_k
+    agg = FleetAggregator(base)
+    agg.start(subscribe=True)
+
+    # Global heavy flows: every node carries a share every epoch, so the
+    # cluster totals exist on NO single node — recall against exact
+    # merged counts proves the cross-node CMS summation.
+    heavy = rng.integers(0, 2**32, size=(heavy_flows, 4), dtype=np.uint32)
+    victim = nodes - 1
+    exact_lock = threading.Lock()
+    # (epoch, node) -> Counter of exact per-flow weights SHIPPED.
+    exact: dict[tuple[int, str], Counter] = {}
+
+    shippers: list[SnapshotShipper] = []
+    for i in range(nodes):
+        cfg_i = dataclasses.replace(
+            base,
+            fleet_node_name=f"sim{i:02d}",
+            fleet_tenant=f"tenant{i % 4}",
+            fleet_priority=i % 4,
+        )
+        s = SnapshotShipper(cfg_i)
+        s.start()
+        shippers.append(s)
+
+    # Prewarm the sketch-build jit grid at the real batch shape before
+    # pacing starts: first-call compiles take seconds and would skew
+    # epoch-0 arrivals past the straggler timeout, closing buckets early
+    # and dropping the stragglers' frames as late.
+    _sketch_arrays(
+        np.zeros((heavy_flows + light_flows, 4), np.uint32),
+        np.ones(heavy_flows + light_flows),
+    )
+
+    epoch_interval = 0.25
+    t0 = time.monotonic()
+
+    def agent(i: int) -> None:
+        node_rng = np.random.default_rng(seed * 1000 + i)
+        ship = shippers[i]
+        for e in range(epochs):
+            if i == victim and e >= kill_after:
+                return  # killed mid-run: stops shipping, no goodbye
+            # Pace agents onto a shared epoch cadence (NTP-close clocks).
+            wait = t0 + e * epoch_interval - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            hw = node_rng.integers(100, 200, size=heavy_flows)
+            lkeys = node_rng.integers(
+                0, 2**32, size=(light_flows, 4), dtype=np.uint32
+            )
+            lw = node_rng.integers(1, 4, size=light_flows)
+            keys = np.concatenate([heavy, lkeys])
+            w = np.concatenate([hw, lw]).astype(np.float64)
+            arrays = _sketch_arrays(keys, w)
+            c = Counter()
+            for row, wt in zip(keys, w):
+                c[tuple(int(x) for x in row)] += int(wt)
+            with exact_lock:
+                exact[(e, ship.node)] = c
+            ship.offer(e, arrays, 15.0, dict(SEEDS))
+
+    threads = [
+        threading.Thread(target=agent, args=(i,), name=f"fleet-sim{i}")
+        for i in range(nodes)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Let the straggler timeout close the post-kill epochs. Generous
+    # ceiling: the first n-node and (n-1)-node batched-merge programs
+    # compile cold here (seconds each); the loop exits as soon as every
+    # epoch is merged, so healthy runs never wait this long.
+    deadline = time.monotonic() + straggler_timeout_s * 4 + 60.0
+    while agg.epochs_merged < epochs and time.monotonic() < deadline:
+        time.sleep(0.05)
+    for s in shippers:
+        s.stop()
+    agg.stop()
+
+    # -- scorecard -----------------------------------------------------
+    rollups = list(agg.rollups)
+    recalls: dict[int, float] = {}
+    top_err: dict[int, float] = {}
+    for r in rollups:
+        e = r["epoch"]
+        merged_exact: Counter = Counter()
+        for node in r["nodes"]:
+            merged_exact.update(exact.get((e, node), Counter()))
+        if not merged_exact:
+            continue
+        exact_top = [
+            kk for kk, _ in merged_exact.most_common(k)
+        ]
+        keys_arr, counts_arr = r["top_flow"]
+        got = {tuple(int(x) for x in row) for row in keys_arr}
+        recalls[e] = sum(1 for kk in exact_top if kk in got) / len(exact_top)
+        # Count accuracy on the true heaviest flow (CMS may overestimate,
+        # never under): relative error of the reported cluster count.
+        kk = exact_top[0]
+        for row, cnt in zip(keys_arr, counts_arr):
+            if tuple(int(x) for x in row) == kk:
+                top_err[e] = abs(float(cnt) - merged_exact[kk]) / max(
+                    merged_exact[kk], 1
+                )
+                break
+    recall = min(recalls.values()) if recalls else 0.0
+    tenants_seen = max((len(r["tenants"]) for r in rollups), default=0)
+    series_obs = max(
+        (
+            len(tr["top_flows"][0])
+            for r in rollups for tr in r["tenants"].values()
+        ),
+        default=0,
+    )
+    bound = min(base.fleet_topk_k, base.fleet_tenant_series_max)
+    straggled = sum(1 for r in rollups if r.get("straggled"))
+    post_kill = [
+        r for r in rollups if r["epoch"] >= kill_after
+    ]
+    res = {
+        "nodes": nodes,
+        "epochs": epochs,
+        "epochs_merged": agg.epochs_merged,
+        "recall_min": round(recall, 4),
+        "recall_per_epoch": {e: round(v, 4) for e, v in recalls.items()},
+        "top_count_rel_err": {
+            e: round(v, 4) for e, v in top_err.items()
+        },
+        "killed_node": shippers[victim].node,
+        "kill_after": kill_after,
+        "straggled_epochs": straggled,
+        "post_kill_nodes": (
+            [len(r["nodes"]) for r in post_kill]
+        ),
+        "frames_shipped": sum(s.shipped for s in shippers),
+        "tenants_seen": tenants_seen,
+        "tenant_series_bound": bound,
+        "tenant_series_max_observed": series_obs,
+        "ok": bool(
+            agg.epochs_merged >= epochs
+            and recall >= 0.95
+            and series_obs <= bound
+            and tenants_seen <= base.fleet_max_tenants
+        ),
+    }
+    log(
+        f"fleet dryrun: {nodes} agents, {agg.epochs_merged}/{epochs} "
+        f"epochs merged, min recall {recall:.3f}, "
+        f"{straggled} straggled (node {shippers[victim].node} killed "
+        f"after epoch {kill_after - 1}), tenant series "
+        f"{series_obs}<={bound}"
+    )
+    return res

@@ -1,0 +1,207 @@
+"""Node-side snapshot shipper: window close -> wire frame -> relay.
+
+At each window close the engine dispatches the on-device fleet export
+(one psum/pmax/all_gather pass over the mesh, parallel/telemetry.py)
+and hands the resulting device dict to this shipper's bounded queue.
+The worker thread does everything slow OFF the device proxy: readback
+(fetch_on_device per leaf — polls readiness, never parks the proxy),
+encode (fleet/codec.py), and the transport send.
+
+Backpressure contract (the repo-wide rule): never block the close path
+— a full queue drops the snapshot and counts it. Overload contract:
+under SHEDDING and above, the shipper backs off to shipping 1 window in
+``fleet_shed_ship_every`` (the rollup is the cheapest remote work to
+lose; local scrape metrics stay complete).
+
+Transport is pluggable: default is the in-process pubsub bus
+(FLEET_TOPIC — the aggregator subscribes when co-located); when
+``fleet_relay_addr`` is set, frames go over the hubble relay's
+"retina.Fleet" Ship RPC instead (hubble/server.py).
+"""
+
+from __future__ import annotations
+
+import os
+import queue as queue_mod
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from retina_tpu.fleet.codec import FLEET_TOPIC, FleetSnapshot, encode_snapshot
+from retina_tpu.log import logger, rate_limited
+from retina_tpu.metrics import get_metrics
+from retina_tpu.pubsub import get_pubsub
+from retina_tpu.runtime.overload import SHEDDING
+from retina_tpu.utils.device_proxy import fetch_on_device
+
+
+class SnapshotShipper:
+    """Owns the ship queue + worker thread for one node agent."""
+
+    def __init__(
+        self,
+        cfg,
+        overload=None,  # OverloadController (state read only)
+        supervisor=None,  # runtime/supervisor.py Supervisor
+        transport: Optional[Callable[[bytes], None]] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.log = logger("fleet.shipper")
+        self.node = cfg.fleet_node_name or cfg.node_name or (
+            f"node-{os.getpid()}"
+        )
+        self.tenant = cfg.fleet_tenant
+        self.priority = int(cfg.fleet_priority)
+        self._overload = overload
+        self._supervisor = supervisor
+        self._transport = transport
+        self._grpc_client: Any = None
+        self._q: queue_mod.Queue = queue_mod.Queue(
+            maxsize=max(1, int(cfg.fleet_ship_queue))
+        )
+        self._seq = 0
+        self._win_count = 0  # windows offered (shed-backoff modulus)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.shipped = 0  # frames actually sent (tests/dryrun)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=f"fleet-ship-{self.node}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        self._q.put(None)  # wake the worker
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+        if self._supervisor is not None and self._thread is not None:
+            self._supervisor.deregister(f"fleet-ship-{self.node}")
+        self._thread = None
+
+    # -- close-path entry (device-proxy thread; must never block) ------
+    def offer(
+        self,
+        epoch: int,
+        arrays: dict[str, Any],
+        window_s: float,
+        seeds: dict[str, int],
+    ) -> bool:  # runs-on: device-proxy
+        """Enqueue one window's export for shipping. ``arrays`` values
+        may be device arrays (fetched on the worker) or host numpy.
+        Returns False when deferred (overload backoff) or dropped
+        (queue full / stopped)."""
+        if self._stop.is_set():
+            return False
+        m = get_metrics()
+        with self._lock:
+            self._win_count += 1
+            count = self._win_count
+        ov = self._overload
+        if ov is not None and ov.state >= SHEDDING:
+            every = max(1, int(self.cfg.fleet_shed_ship_every))
+            if count % every != 0:
+                m.fleet_ship_deferred.inc()
+                return False
+        try:
+            self._q.put_nowait((epoch, arrays, window_s, seeds))
+            return True
+        except queue_mod.Full:
+            m.fleet_ship_dropped.inc()
+            if rate_limited("fleet.ship_queue_full"):
+                self.log.warning(
+                    "fleet ship queue full; dropping epoch %d", epoch
+                )
+            return False
+
+    # -- worker --------------------------------------------------------
+    def _run(self) -> None:  # runs-on: fleet-ship
+        hb = None
+        if self._supervisor is not None:
+            hb = self._supervisor.register(
+                f"fleet-ship-{self.node}", self.cfg.watchdog_deadline_s
+            )
+        while not self._stop.is_set():
+            if hb is not None:
+                hb.park()
+            item = self._q.get()
+            if item is None or self._stop.is_set():
+                break
+            if hb is not None:
+                hb.beat()
+            try:
+                self._ship_one(*item)
+            except Exception:
+                get_metrics().fleet_ship_errors.inc()
+                if rate_limited("fleet.ship"):
+                    self.log.exception("fleet snapshot ship failed")
+
+    def _ship_one(
+        self,
+        epoch: int,
+        arrays: dict[str, Any],
+        window_s: float,
+        seeds: dict[str, int],
+    ) -> None:
+        host: dict[str, np.ndarray] = {}
+        for name, arr in arrays.items():
+            if isinstance(arr, np.ndarray):
+                host[name] = arr
+            else:
+                host[name] = fetch_on_device(arr)
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        snap = FleetSnapshot(
+            node=self.node, tenant=self.tenant, priority=self.priority,
+            epoch=int(epoch), seq=seq, window_s=float(window_s),
+            seeds=seeds, arrays=host,
+        )
+        frame = encode_snapshot(snap)
+        self._send(frame)
+        m = get_metrics()
+        m.fleet_snapshots_shipped.inc()
+        m.fleet_ship_bytes.inc(len(frame))
+        self.shipped += 1
+
+    def _send(self, frame: bytes) -> None:
+        if self._transport is not None:
+            self._transport(frame)
+            return
+        addr = self.cfg.fleet_relay_addr
+        if addr:
+            if self._grpc_client is None:
+                # Lazy import: grpc is optional at module import time
+                # (same gating as hubble/server.py).
+                from retina_tpu.hubble.server import FleetShipClient
+
+                self._grpc_client = FleetShipClient(addr)
+            self._grpc_client.ship(frame)
+            return
+        get_pubsub().publish(FLEET_TOPIC, frame)
+
+    # -- observability -------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "node": self.node,
+            "tenant": self.tenant,
+            "seq": self._seq,
+            "shipped": self.shipped,
+            "queue_depth": self._q.qsize(),
+        }
+
+
+def window_epoch(window_s: float, now: float | None = None) -> int:
+    """Wall-clock window epoch — aligned across nodes whose clocks are
+    NTP-close (a skew below window_s/2 lands in the right bucket; the
+    aggregator's straggler timeout absorbs the rest)."""
+    now = time.time() if now is None else now
+    return int(now // max(window_s, 1e-6))

@@ -27,7 +27,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent import futures
-from typing import Any, Iterator, Optional
+from typing import Any, Callable, Iterator, Optional
 
 import grpc
 import msgpack
@@ -41,6 +41,11 @@ _unpack = lambda raw: msgpack.unpackb(raw, raw=False, strict_map_key=False)
 
 OBSERVER_SERVICE = "retina.Observer"
 PEER_SERVICE = "retina.Peer"
+# Fleet rollup tier (fleet/): nodes Ship encoded sketch snapshots to the
+# aggregator through the relay endpoint instead of raw samples. Raw-bytes
+# unary RPC — the RFLT frame (fleet/codec.py) is the wire format, so the
+# relay never unpacks the arrays.
+FLEET_SERVICE = "retina.Fleet"
 
 
 class HubbleServer:
@@ -55,6 +60,7 @@ class HubbleServer:
         tls_key: str = "",
         tls_client_ca: str = "",
         unix_socket: str = "",
+        fleet_ingest: Optional[Callable[[bytes], bool]] = None,
     ):
         self._log = logger("hubble")
         self.observer = observer
@@ -65,6 +71,9 @@ class HubbleServer:
         # listings track cluster membership instead of boot-time config).
         self.peers = peers if peers is not None else []
         self.node_name = node_name
+        # Operator wiring: FleetAggregator.ingest when this relay fronts
+        # the aggregator; None on plain per-node relays (Ship → error).
+        self.fleet_ingest = fleet_ingest
         self._t0 = time.time_ns()
         self._stop = threading.Event()
         self._init_self_metrics()
@@ -192,6 +201,18 @@ class HubbleServer:
     def _list_peers(self, request: bytes, ctx) -> bytes:
         return _pack({"peers": self._peer_list()})
 
+    def _fleet_ship(self, request: bytes, ctx) -> bytes:
+        """Unary Ship: one RFLT frame in, {"ok": bool} out. Accepted
+        means decoded + buffered (or merged); a False ok surfaces drop
+        reasons the node side can count without parsing relay logs."""
+        if self.fleet_ingest is None:
+            return _pack({"ok": False, "error": "no aggregator here"})
+        try:
+            return _pack({"ok": bool(self.fleet_ingest(request))})
+        except Exception as e:  # noqa: BLE001 — relay must answer
+            self._log.exception("fleet ingest failed")
+            return _pack({"ok": False, "error": repr(e)})
+
     def _make_handlers(self):
         bypass = lambda x: x  # already-packed bytes
         observer = grpc.method_handlers_generic_handler(
@@ -219,10 +240,24 @@ class HubbleServer:
                 ),
             },
         )
+        fleet = grpc.method_handlers_generic_handler(
+            FLEET_SERVICE,
+            {
+                "Ship": grpc.unary_unary_rpc_method_handler(
+                    self._fleet_ship,
+                    request_deserializer=bypass,
+                    response_serializer=bypass,
+                ),
+            },
+        )
 
         class Multi(grpc.GenericRpcHandler):
             def service(self, details):
-                return observer.service(details) or peer.service(details)
+                return (
+                    observer.service(details)
+                    or peer.service(details)
+                    or fleet.service(details)
+                )
 
         return Multi()
 
@@ -428,6 +463,28 @@ class HubbleClient:
 
     def list_peers(self) -> list[dict[str, str]]:
         return _unpack(self._peers(_pack({}), timeout=5))["peers"]
+
+    def close(self) -> None:
+        self._chan.close()
+
+
+class FleetShipClient:
+    """Node-side client for the relay's retina.Fleet/Ship endpoint.
+    Sends already-encoded RFLT frames; the shipper owns retry/drop
+    policy, this class only moves bytes."""
+
+    def __init__(self, addr: str, timeout_s: float = 5.0):
+        self._chan = grpc.insecure_channel(addr)
+        self._timeout = timeout_s
+        bypass = lambda x: x
+        self._ship = self._chan.unary_unary(
+            f"/{FLEET_SERVICE}/Ship",
+            request_serializer=bypass, response_deserializer=bypass,
+        )
+
+    def ship(self, frame: bytes) -> bool:
+        resp = _unpack(self._ship(frame, timeout=self._timeout))
+        return bool(resp.get("ok", False))
 
     def close(self) -> None:
         self._chan.close()

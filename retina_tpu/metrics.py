@@ -132,6 +132,39 @@ class Metrics:
         # tunnel link they share the same pipe as transfer_bytes, so
         # link-utilization math must sum both directions.
         self.readback_bytes = c(mn.READBACK_BYTES, [])
+        # Fleet rollup tier (fleet/; see metric_names for semantics).
+        # Node-side shipper:
+        self.fleet_snapshots_shipped = c(mn.FLEET_SNAPSHOTS_SHIPPED, [])
+        self.fleet_ship_bytes = c(mn.FLEET_SHIP_BYTES, [])
+        self.fleet_ship_deferred = c(mn.FLEET_SHIP_DEFERRED, [])
+        self.fleet_ship_dropped = c(mn.FLEET_SHIP_DROPPED, [])
+        self.fleet_ship_errors = c(mn.FLEET_SHIP_ERRORS, [])
+        # Operator-side aggregator:
+        self.fleet_snapshots_received = c(
+            mn.FLEET_SNAPSHOTS_RECEIVED, [mn.L_NODE]
+        )
+        self.fleet_snapshots_dropped = c(
+            mn.FLEET_SNAPSHOTS_DROPPED, [mn.L_REASON]
+        )
+        self.fleet_windows_merged = c(mn.FLEET_WINDOWS_MERGED, [])
+        self.fleet_windows_stragglers = c(mn.FLEET_WINDOWS_STRAGGLERS, [])
+        self.fleet_merge_errors = c(mn.FLEET_MERGE_ERRORS, [])
+        self.fleet_merge_seconds = g(mn.FLEET_MERGE_SECONDS, [])
+        self.fleet_nodes_reporting = g(mn.FLEET_NODES_REPORTING, [])
+        # Keyed cluster families (cleared + re-published per epoch;
+        # label space bounded by the fleet guardrail knobs).
+        self.fleet_top_flows = g(mn.FLEET_TOP_FLOWS, [mn.L_KEY])
+        self.fleet_tenant_top_flows = g(
+            mn.FLEET_TENANT_TOP_FLOWS, [mn.L_TENANT, mn.L_KEY]
+        )
+        self.fleet_service_cardinality = g(
+            mn.FLEET_SERVICE_CARDINALITY, [mn.L_SERVICE]
+        )
+        self.fleet_entropy_bits = g(mn.FLEET_ENTROPY_BITS, [mn.L_DIMENSION])
+        self.fleet_distinct_flows = g(mn.FLEET_DISTINCT_FLOWS, [])
+        self.fleet_tenant_series = g(mn.FLEET_TENANT_SERIES, [mn.L_TENANT])
+        self.fleet_series_capped = c(mn.FLEET_SERIES_CAPPED, [])
+        self.fleet_tenants_shed = c(mn.FLEET_TENANTS_SHED, [])
 
 
 _singleton: Metrics | None = None

@@ -102,6 +102,37 @@ class TopKTable:
         sel = counts[order] > 0
         return keys[order][sel], counts[order][sel]
 
+    def merge(self, other: "TopKTable") -> "TopKTable":
+        """Join-semilattice slot merge for cross-node/device rollup.
+
+        Per slot, keep the lexicographically greater ``(count,
+        key_row)`` pair — a total order, so the join is associative,
+        commutative, AND idempotent (a naive max-count merge that keeps
+        "either" key on ties is not commutative; the fleet aggregator's
+        property tests require chained == pairwise). Counts stay valid
+        candidate estimates: cluster-accurate counts come from querying
+        the summed CMS at the union of candidates, never from this
+        table (fleet/aggregator.py).
+        """
+        if self.seed != other.seed:
+            raise ValueError(
+                f"TopKTable seed mismatch: {self.seed} != {other.seed}"
+            )
+        a_c, b_c = self.counts, other.counts
+        ka, kb = self.key_rows, other.key_rows
+        # Tie-break equal counts on the first differing key column.
+        diff = ka != kb  # (S, C)
+        first = jnp.argmax(diff, axis=1)
+        col_a = jnp.take_along_axis(ka, first[:, None], axis=1)[:, 0]
+        col_b = jnp.take_along_axis(kb, first[:, None], axis=1)[:, 0]
+        b_key_greater = diff.any(axis=1) & (col_b > col_a)
+        take_b = (b_c > a_c) | ((b_c == a_c) & b_key_greater)
+        return dataclasses.replace(
+            self,
+            key_rows=jnp.where(take_b[:, None], kb, ka),
+            counts=jnp.where(take_b, b_c, a_c),
+        )
+
     def reset(self) -> "TopKTable":
         return dataclasses.replace(
             self,
@@ -146,6 +177,13 @@ class HeavyHitterSketch:
         est = cms.query(key_cols)
         est = jnp.where(weights > 0, est, 0)
         return HeavyHitterSketch(cms=cms, table=self.table.update(key_cols, est))
+
+    def merge(self, other: "HeavyHitterSketch") -> "HeavyHitterSketch":
+        """CMS tables add; candidate tables join (see TopKTable.merge)."""
+        return HeavyHitterSketch(
+            cms=self.cms.merge(other.cms),
+            table=self.table.merge(other.table),
+        )
 
     def reset(self) -> "HeavyHitterSketch":
         return HeavyHitterSketch(cms=self.cms.reset(), table=self.table.reset())

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from retina_tpu.models.identity import IdentityMap
 from retina_tpu.models.pipeline import PipelineConfig, PipelineState, TelemetryPipeline
+from retina_tpu.ops.topk import TopKTable
 
 # jax >= 0.5 promotes shard_map to the top-level namespace and renames
 # the replication checker kwarg check_rep -> check_vma; 0.4.x keeps both
@@ -45,6 +46,72 @@ else:  # pragma: no cover - depends on installed jax
         if "check_vma" in kw:
             kw["check_rep"] = kw.pop("check_vma")
         return _exp_shard_map(f, **kw)
+
+
+class AotProgram:
+    """Aval-keyed AOT executable cache around a jitted program.
+
+    The plain ``jax.jit`` cache keys on input *shardings* as well as
+    avals, and the state pytree's sharding spelling flips between
+    ``init_state``'s ``out_shardings`` (``P(('data',))``) and the
+    jit-normalized step output — so the very first warm-up step used to
+    compile TWICE (the 2.1s->96.1s cold-start swings, ROADMAP item 5).
+    This wrapper keys ONLY on (tree structure, per-leaf shape/dtype) and
+    lowers each signature once with canonical shardings; the compiled
+    executable then accepts committed arrays with any equivalent
+    sharding spelling as well as raw host (numpy) arrays, so ragged
+    feeds and recovery rebuilds reuse the one resident executable.
+
+    ``donate_argnums`` declared on the wrapped jit carry through
+    ``lower().compile()`` untouched. ``_cache_size()`` mirrors the
+    private jit introspection hook the stability tests assert on.
+    """
+
+    def __init__(self, jitted, mesh: Mesh, sharded_spec,
+                 sharded_argnums: tuple[int, ...]):
+        self._jitted = jitted
+        self._mesh = mesh
+        self._spec = sharded_spec
+        self._sharded_argnums = frozenset(sharded_argnums)
+        self._execs: dict[Any, Any] = {}
+
+    def _signature(self, args) -> Any:
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return treedef, tuple(
+            (np.shape(leaf), np.dtype(
+                getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+            ).name)
+            for leaf in leaves
+        )
+
+    def _lower(self, args):
+        def struct(i, leaf):
+            sh = NamedSharding(
+                self._mesh,
+                self._spec if i in self._sharded_argnums else P(),
+            )
+            return jax.ShapeDtypeStruct(
+                np.shape(leaf), np.asarray(leaf).dtype
+                if not hasattr(leaf, "dtype") else leaf.dtype,
+                sharding=sh,
+            )
+
+        specs = tuple(
+            jax.tree.map(lambda leaf, i=i: struct(i, leaf), arg)
+            for i, arg in enumerate(args)
+        )
+        return self._jitted.lower(*specs).compile()
+
+    def __call__(self, *args):
+        key = self._signature(args)
+        ex = self._execs.get(key)
+        if ex is None:
+            ex = self._lower(args)
+            self._execs[key] = ex
+        return ex(*args)
+
+    def _cache_size(self) -> int:
+        return len(self._execs)
 
 
 class ShardedTelemetry:
@@ -64,6 +131,7 @@ class ShardedTelemetry:
         self._end_window = None
         self._snapshot = None
         self._snapshot_flat = None
+        self._fleet_export = None
 
     # ------------------------------------------------------------------
     def init_state(self) -> PipelineState:
@@ -127,7 +195,12 @@ class ShardedTelemetry:
                 },
             ),
         )
-        return jax.jit(fn, donate_argnums=(0,))
+        # AOT-wrapped (AotProgram): argnums 0-2 (state, records, n_valid)
+        # carry the mesh sharding, the scalar/replicated tail does not.
+        return AotProgram(
+            jax.jit(fn, donate_argnums=(0,)), self.mesh,
+            self._sharded_spec, (0, 1, 2),
+        )
 
     def step(
         self,
@@ -210,7 +283,10 @@ class ShardedTelemetry:
             # window entropy) — the checker cannot prove that invariant.
             check_vma=False,
         )
-        return jax.jit(fn, donate_argnums=(0,))
+        return AotProgram(
+            jax.jit(fn, donate_argnums=(0,)), self.mesh,
+            self._sharded_spec, (0,),
+        )
 
     def end_window(
         self, state: PipelineState, z_thresh: float = 4.0
@@ -278,6 +354,75 @@ class ShardedTelemetry:
         if self._snapshot is None:
             self._snapshot = self._build_snapshot()
         return self._snapshot(state, jnp.asarray(now_s, jnp.uint32))
+
+    # ------------------------------------------------------------------
+    def _build_fleet_export(self):
+        ax = self.axes
+        d = self.n_devices
+
+        def local_fx(state):
+            s = jax.tree.map(lambda x: x[0], state)
+            psum = lambda x: jax.lax.psum(x, ax)
+            pmax = lambda x: jax.lax.pmax(x, ax)
+            gather = lambda x: jax.lax.all_gather(x, ax, axis=0)
+
+            def fold_table(table):
+                # Gather every device's candidate table, then fold with
+                # the join-semilattice merge (ops/topk.py) so the wire
+                # snapshot carries ONE (S, C) table per family.
+                keys = gather(table.key_rows)  # (D, S, C)
+                counts = gather(table.counts)  # (D, S)
+                t = TopKTable(keys[0], counts[0], seed=table.seed)
+                for i in range(1, d):
+                    t = t.merge(
+                        TopKTable(keys[i], counts[i], seed=table.seed)
+                    )
+                return t
+
+            out = {}
+            for fam, hh in (  # noqa: RT212 — static 3-family tuple; intended unroll
+                ("flow", s.flow_hh), ("svc", s.svc_hh), ("dns", s.dns_hh)
+            ):
+                t = fold_table(hh.table)
+                out[f"{fam}_cms"] = psum(hh.cms.table)
+                out[f"{fam}_keys"] = t.key_rows
+                out[f"{fam}_counts"] = t.counts
+            out["hll_flows"] = pmax(s.hll_flows.registers)
+            out["hll_src_per_pod"] = pmax(s.hll_src_per_pod.registers)
+            out["entropy"] = psum(s.entropy.counts)
+            out["totals"] = psum(s.totals)
+            return out
+
+        fn = _shard_map(
+            local_fx,
+            mesh=self.mesh,
+            in_specs=(self._sharded_spec,),
+            out_specs=P(),  # every output collective-merged => replicated
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def fleet_export(self, state: PipelineState) -> dict[str, Any]:
+        """Device-merged wire snapshot for the fleet rollup tier
+        (fleet/codec.py array catalog). Async dispatch: the shipper does
+        the readback off the proxy (fleet/shipper.py)."""
+        if self._fleet_export is None:
+            self._fleet_export = self._build_fleet_export()
+        return self._fleet_export(state)
+
+    @staticmethod
+    def fleet_seeds(state: PipelineState) -> dict[str, int]:
+        """Per-family sketch hash seeds (pytree aux — host-side attribute
+        reads, no device sync). Shipped in every frame so the aggregator
+        can refuse cross-seed merges."""
+        return {
+            "flow": int(state.flow_hh.cms.seed),
+            "svc": int(state.svc_hh.cms.seed),
+            "dns": int(state.dns_hh.cms.seed),
+            "hll_flows": int(state.hll_flows.seed),
+            "hll_src_per_pod": int(state.hll_src_per_pod.seed),
+            "entropy": int(state.entropy.seed),
+        }
 
     # ------------------------------------------------------------------
     def _build_snapshot_flat(self, state: PipelineState):

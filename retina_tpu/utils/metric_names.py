@@ -128,6 +128,49 @@ TRANSFER_SECONDS = PREFIX + "tpu_transfer_seconds"
 TRANSFER_BYTES = PREFIX + "tpu_transfer_bytes"
 READBACK_BYTES = PREFIX + "tpu_readback_bytes"
 
+# Fleet rollup tier (fleet/): cluster-wide series published by the
+# operator-side aggregator, plus node-side shipper self-metrics.
+# Shipper: snapshots_shipped counts frames actually sent;
+# ship_bytes the encoded wire bytes; ship_deferred windows skipped by
+# the SHEDDING backoff (1-in-fleet_shed_ship_every); ship_dropped
+# windows lost to a full ship queue; ship_errors failed sends.
+# Aggregator: snapshots_received{node} accepted frames;
+# snapshots_dropped{reason} rejects (decode/late/duplicate/
+# seed_mismatch/shape_mismatch); windows_merged closed epochs;
+# windows_stragglers epochs closed by timeout instead of quorum;
+# merge_errors failed poll/merge passes; merge_seconds the last
+# epoch's merge wall time; nodes_reporting the node count of the last
+# merged epoch. Keyed families are cleared and re-published per epoch
+# so their label space is bounded by the guardrail knobs:
+# top_flow_packets{key} <= fleet_topk_k series,
+# tenant_top_flow_packets{tenant,key} <= fleet_tenant_series_max per
+# tenant over <= fleet_max_tenants tenants (tenant_series{tenant}
+# reports each tenant's exported count; series_capped/tenants_shed
+# count guardrail enforcement), service_cardinality{service} <=
+# fleet_service_top series; entropy_bits{dimension} and
+# distinct_flows are fixed-cardinality cluster estimates.
+FLEET_PREFIX = PREFIX + "fleet_"
+FLEET_SNAPSHOTS_SHIPPED = FLEET_PREFIX + "snapshots_shipped_counter"
+FLEET_SHIP_BYTES = FLEET_PREFIX + "ship_bytes_counter"
+FLEET_SHIP_DEFERRED = FLEET_PREFIX + "ship_deferred_counter"
+FLEET_SHIP_DROPPED = FLEET_PREFIX + "ship_dropped_counter"
+FLEET_SHIP_ERRORS = FLEET_PREFIX + "ship_errors_counter"
+FLEET_SNAPSHOTS_RECEIVED = FLEET_PREFIX + "snapshots_received_counter"
+FLEET_SNAPSHOTS_DROPPED = FLEET_PREFIX + "snapshots_dropped_counter"
+FLEET_WINDOWS_MERGED = FLEET_PREFIX + "windows_merged_counter"
+FLEET_WINDOWS_STRAGGLERS = FLEET_PREFIX + "windows_stragglers_counter"
+FLEET_MERGE_ERRORS = FLEET_PREFIX + "merge_errors_counter"
+FLEET_MERGE_SECONDS = FLEET_PREFIX + "merge_seconds"
+FLEET_NODES_REPORTING = FLEET_PREFIX + "nodes_reporting"
+FLEET_TOP_FLOWS = FLEET_PREFIX + "top_flow_packets"
+FLEET_TENANT_TOP_FLOWS = FLEET_PREFIX + "tenant_top_flow_packets"
+FLEET_SERVICE_CARDINALITY = FLEET_PREFIX + "service_cardinality"
+FLEET_ENTROPY_BITS = FLEET_PREFIX + "entropy_bits"
+FLEET_DISTINCT_FLOWS = FLEET_PREFIX + "distinct_flows"
+FLEET_TENANT_SERIES = FLEET_PREFIX + "tenant_series"
+FLEET_SERIES_CAPPED = FLEET_PREFIX + "series_capped_counter"
+FLEET_TENANTS_SHED = FLEET_PREFIX + "tenants_shed_counter"
+
 # Label keys (reference pkg/utils/metric_names.go label constants).
 L_DIRECTION = "direction"
 L_REASON = "reason"
@@ -150,3 +193,7 @@ L_SITE = "site"
 L_INTERFACE = "interface_name"
 L_STAT = "statistic_name"
 L_BUCKET = "le_ms"
+L_TENANT = "tenant"
+L_KEY = "key"
+L_NODE = "node"
+L_SERVICE = "service"

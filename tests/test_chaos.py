@@ -380,3 +380,26 @@ def test_sampling_preserves_heavy_hitter_recall():
     assert ann["overload_state"] == "SAMPLING"
     assert ann["events_sampled"] > 0
     assert 0.0 < ann["sampled_fraction"] < 1.0
+
+
+def test_fleet_node_dropout_rollup_continues():
+    """Fleet rollup chaos: one of the simulated node agents is killed
+    mid-run. Every epoch must still merge — post-kill epochs close via
+    the straggler timeout with the surviving nodes, cluster top-k recall
+    holds >= 0.95 vs the exact merged counts of the nodes actually
+    merged, and the per-tenant label guardrail stays bounded (the dead
+    node never blocks or skews the rollup beyond its dropped share)."""
+    from retina_tpu.fleet.dryrun import run_dryrun
+
+    res = run_dryrun(
+        nodes=6, epochs=3, kill_after=1, straggler_timeout_s=0.5
+    )
+    assert res["epochs_merged"] == 3, res
+    assert res["recall_min"] >= 0.95, res
+    # Post-kill epochs merged the survivors, not a stale quorum.
+    assert res["post_kill_nodes"], res
+    assert all(n == 5 for n in res["post_kill_nodes"]), res
+    assert res["straggled_epochs"] >= 1, res
+    # Guardrail: per-tenant exported series bounded by the knob.
+    assert res["tenant_series_max_observed"] <= res["tenant_series_bound"]
+    assert res["ok"], res

@@ -1,0 +1,474 @@
+"""Fleet rollup tier (retina_tpu/fleet): codec, merge algebra, shipper,
+aggregator, and the engine close-path integration.
+
+The merge property tests are the load-bearing part: cluster rollups are
+only correct if every sketch merge is associative + commutative (frames
+arrive in arbitrary node order, and the aggregator folds them in sorted
+order that differs from ship order). Entropy tests use INTEGER weights:
+float32 addition over integer-valued counts is exact, so equality is
+bit-for-bit, not approximate.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from retina_tpu.config import Config
+from retina_tpu.fleet import (
+    FleetAggregator,
+    FleetDecodeError,
+    FleetSnapshot,
+    SnapshotShipper,
+    decode_snapshot,
+    encode_snapshot,
+)
+from retina_tpu.fleet.codec import ARRAY_CATALOG
+from retina_tpu.fleet.dryrun import SEEDS, _sketch_arrays
+from retina_tpu.fleet.shipper import window_epoch
+from retina_tpu.metrics import get_metrics
+from retina_tpu.ops.countmin import CountMinSketch
+from retina_tpu.ops.entropy import EntropyWindow
+from retina_tpu.ops.hyperloglog import HyperLogLog
+from retina_tpu.ops.topk import HeavyHitterSketch, TopKTable
+
+
+# -- helpers -----------------------------------------------------------
+def _rand_arrays(rng, b=64):
+    keys = rng.integers(0, 2**32, size=(b, 4), dtype=np.uint32)
+    w = rng.integers(1, 100, size=b).astype(np.float64)
+    return _sketch_arrays(keys, w)
+
+
+def _snap(node="n0", epoch=1, arrays=None, seeds=None, **kw):
+    rng = np.random.default_rng(hash(node) % 2**32)
+    return FleetSnapshot(
+        node=node,
+        tenant=kw.pop("tenant", "default"),
+        priority=kw.pop("priority", 0),
+        epoch=epoch,
+        seq=kw.pop("seq", 0),
+        window_s=15.0,
+        seeds=dict(SEEDS) if seeds is None else seeds,
+        arrays=_rand_arrays(rng) if arrays is None else arrays,
+    )
+
+
+# -- codec -------------------------------------------------------------
+def test_codec_round_trip_exact():
+    snap = _snap(node="node-a", epoch=42, tenant="t1", priority=3, seq=7)
+    frame = encode_snapshot(snap)
+    back = decode_snapshot(frame)
+    assert back.node == "node-a"
+    assert back.tenant == "t1"
+    assert back.priority == 3
+    assert back.epoch == 42
+    assert back.seq == 7
+    assert back.window_s == 15.0
+    assert back.seeds == snap.seeds
+    assert set(back.arrays) == set(snap.arrays)
+    for name, arr in snap.arrays.items():
+        got = back.arrays[name]
+        assert got.dtype == ARRAY_CATALOG[name][0]
+        np.testing.assert_array_equal(got, arr)
+
+
+def test_codec_deterministic_bytes():
+    snap = _snap()
+    assert encode_snapshot(snap) == encode_snapshot(snap)
+
+
+def test_codec_rejects_garbage():
+    frame = encode_snapshot(_snap())
+    with pytest.raises(FleetDecodeError):
+        decode_snapshot(b"XXXX" + frame[4:])  # magic
+    with pytest.raises(FleetDecodeError):
+        decode_snapshot(frame[:-10])  # truncated payload
+    with pytest.raises(FleetDecodeError):
+        decode_snapshot(frame + b"\x00")  # trailing bytes
+    with pytest.raises(FleetDecodeError):
+        decode_snapshot(b"")
+
+
+def test_codec_rejects_unknown_array():
+    # The encoder refuses arrays outside the catalog outright...
+    snap = _snap()
+    snap.arrays["not_in_catalog"] = np.zeros(4, np.uint32)
+    with pytest.raises(ValueError):
+        encode_snapshot(snap)
+    # ...and the decoder refuses a tampered header naming one (version
+    # skew defense: a future family must bump VERSION, not sneak in).
+    import struct
+
+    import msgpack
+
+    del snap.arrays["not_in_catalog"]
+    frame = encode_snapshot(snap)
+    hlen = struct.unpack("<I", frame[5:9])[0]
+    header = msgpack.unpackb(frame[9:9 + hlen], raw=False)
+    header["arrays"][0]["n"] = "not_in_catalog"
+    new_header = msgpack.packb(header, use_bin_type=True)
+    tampered = (
+        frame[:5] + struct.pack("<I", len(new_header)) + new_header
+        + frame[9 + hlen:]
+    )
+    with pytest.raises(FleetDecodeError):
+        decode_snapshot(tampered)
+
+
+def test_hll_wire_dtype_is_u8():
+    """HLL registers hold ranks <= 33: shipped as u8 (4x smaller),
+    restored to the sketch's native u32."""
+    snap = _snap()
+    frame = encode_snapshot(snap)
+    back = decode_snapshot(frame)
+    assert back.arrays["hll_flows"].dtype == np.uint32
+    raw = len(encode_snapshot(snap))
+    assert raw < sum(a.nbytes for a in snap.arrays.values())
+
+
+# -- merge algebra -----------------------------------------------------
+def _rand_cms(rng, seed=5):
+    s = CountMinSketch.zeros(2, 1 << 8, seed=seed)
+    keys = [jnp.asarray(rng.integers(0, 2**32, 32, dtype=np.uint32))]
+    return s.update(keys, jnp.asarray(rng.integers(1, 50, 32), jnp.float32))
+
+
+def _rand_hll(rng, seed=5):
+    s = HyperLogLog.zeros(2, 6, seed=seed)
+    keys = [jnp.asarray(rng.integers(0, 2**32, 32, dtype=np.uint32))]
+    g = jnp.asarray(rng.integers(0, 2, 32), jnp.int32)
+    return s.update(keys, g, jnp.ones(32, jnp.float32))
+
+
+def _rand_entropy(rng, seed=5):
+    s = EntropyWindow.zeros(2, 1 << 7, seed=seed)
+    keys = [jnp.asarray(rng.integers(0, 2**32, 32, dtype=np.uint32))]
+    g = jnp.asarray(rng.integers(0, 2, 32), jnp.int32)
+    # INTEGER weights: float32 adds stay exact, equality is bitwise.
+    return s.update(keys, g, jnp.asarray(rng.integers(1, 20, 32), jnp.float32))
+
+
+def _rand_topk(rng, seed=5):
+    s = TopKTable.zeros(2, 64, seed=seed)
+    keys = [
+        jnp.asarray(rng.integers(0, 64, 32, dtype=np.uint32)),
+        jnp.asarray(rng.integers(0, 64, 32, dtype=np.uint32)),
+    ]
+    return s.update(keys, jnp.asarray(rng.integers(1, 100, 32), jnp.uint32))
+
+
+def _eq(a, b):
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize(
+    "mk", [_rand_cms, _rand_hll, _rand_entropy, _rand_topk],
+    ids=["cms", "hll", "entropy", "topk"],
+)
+def test_merge_commutative(mk):
+    rng = np.random.default_rng(1)
+    a, b = mk(rng), mk(rng)
+    _eq(a.merge(b), b.merge(a))
+
+
+@pytest.mark.parametrize(
+    "mk", [_rand_cms, _rand_hll, _rand_entropy, _rand_topk],
+    ids=["cms", "hll", "entropy", "topk"],
+)
+def test_merge_associative(mk):
+    rng = np.random.default_rng(2)
+    a, b, c = mk(rng), mk(rng), mk(rng)
+    _eq(a.merge(b).merge(c), a.merge(b.merge(c)))
+
+
+@pytest.mark.parametrize(
+    "mk", [_rand_cms, _rand_hll, _rand_topk],
+    ids=["cms", "hll", "topk"],
+)
+def test_merge_identity_on_zeros(mk):
+    """merge with a fresh (zero) sketch is the identity — the aggregator
+    may fold in an idle node's empty window."""
+    rng = np.random.default_rng(3)
+    a = mk(rng)
+    zero_rng = np.random.default_rng(3)
+    zero = type(a).zeros(
+        *{
+            CountMinSketch: (2, 1 << 8),
+            HyperLogLog: (2, 6),
+            TopKTable: (2, 64),
+        }[type(a)],
+        seed=5,
+    )
+    del zero_rng
+    _eq(a.merge(zero), a)
+
+
+def test_topk_merge_idempotent():
+    rng = np.random.default_rng(4)
+    a = _rand_topk(rng)
+    _eq(a.merge(a), a)  # join-semilattice: a v a = a
+
+
+def test_topk_merge_seed_mismatch_raises():
+    rng = np.random.default_rng(5)
+    with pytest.raises(ValueError):
+        _rand_topk(rng, seed=1).merge(_rand_topk(rng, seed=2))
+
+
+def test_hh_merge_counts_sum_across_nodes():
+    """The cluster count of a key split across two nodes equals the sum
+    (queried from the merged CMS) — no single node ever held it."""
+    cols = [jnp.asarray(np.full(1, 77, np.uint32))] * 2
+    a = HeavyHitterSketch.zeros(2, depth=2, width=1 << 8, n_slots=8, seed=9)
+    b = HeavyHitterSketch.zeros(2, depth=2, width=1 << 8, n_slots=8, seed=9)
+    a = a.update(cols, jnp.asarray([30.0], jnp.float32))
+    b = b.update(cols, jnp.asarray([12.0], jnp.float32))
+    m = a.merge(b)
+    assert int(np.asarray(m.cms.query(cols))[0]) == 42
+
+
+# -- window epoch ------------------------------------------------------
+def test_window_epoch_alignment():
+    assert window_epoch(15.0, now=150.0) == 10
+    assert window_epoch(15.0, now=164.99) == 10
+    assert window_epoch(15.0, now=165.0) == 11
+    # NTP-close clocks land in the same bucket.
+    assert window_epoch(15.0, now=152.0) == window_epoch(15.0, now=157.0)
+
+
+# -- shipper -----------------------------------------------------------
+def _mk_shipper(transport, **cfg_kw):
+    cfg = Config(fleet_enabled=True, fleet_node_name="ship-test", **cfg_kw)
+    return SnapshotShipper(cfg, transport=transport)
+
+
+def test_shipper_ships_encoded_frames():
+    got: list[bytes] = []
+    s = _mk_shipper(got.append)
+    s.start()
+    try:
+        arrays = _rand_arrays(np.random.default_rng(0))
+        assert s.offer(3, arrays, 15.0, dict(SEEDS))
+        deadline = time.monotonic() + 5
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(got) == 1
+        snap = decode_snapshot(got[0])
+        assert snap.node == "ship-test"
+        assert snap.epoch == 3
+        assert snap.seq == 0
+    finally:
+        s.stop()
+
+
+def test_shipper_queue_full_drops_not_blocks():
+    s = _mk_shipper(lambda b: None, fleet_ship_queue=1)
+    # Worker NOT started: the queue fills and offers must drop fast.
+    arrays = {"totals": np.zeros(8, np.uint32)}
+    assert s.offer(1, arrays, 15.0, dict(SEEDS))
+    before = get_metrics().fleet_ship_dropped._value.get()
+    t0 = time.monotonic()
+    assert not s.offer(2, arrays, 15.0, dict(SEEDS))
+    assert time.monotonic() - t0 < 0.5
+    assert get_metrics().fleet_ship_dropped._value.get() == before + 1
+
+
+def test_shipper_backs_off_under_shedding():
+    class FakeOverload:
+        state = 2  # SHEDDING
+
+    cfg = Config(fleet_enabled=True, fleet_shed_ship_every=4)
+    got: list[bytes] = []
+    s = SnapshotShipper(cfg, overload=FakeOverload(), transport=got.append)
+    arrays = {"totals": np.zeros(8, np.uint32)}
+    accepted = [
+        s.offer(e, arrays, 15.0, dict(SEEDS)) for e in range(8)
+    ]
+    # 1-in-4 accepted while shedding; the rest deferred, never queued.
+    assert accepted.count(True) == 2
+    assert s._q.qsize() == 2
+
+
+# -- aggregator --------------------------------------------------------
+def _agg(**kw):
+    return FleetAggregator(Config(fleet_aggregator=True, **kw))
+
+
+def test_aggregator_quorum_close_and_recall():
+    agg = _agg(fleet_expected_nodes=3, fleet_topk_k=16)
+    rng = np.random.default_rng(7)
+    heavy = rng.integers(0, 2**32, size=(8, 4), dtype=np.uint32)
+    exact: dict[tuple, int] = {}
+    for i in range(3):
+        w = rng.integers(100, 200, size=8)
+        light = rng.integers(0, 2**32, size=(64, 4), dtype=np.uint32)
+        lw = rng.integers(1, 4, size=64)
+        keys = np.concatenate([heavy, light])
+        ws = np.concatenate([w, lw]).astype(np.float64)
+        for row, wt in zip(keys, ws):
+            t = tuple(int(x) for x in row)
+            exact[t] = exact.get(t, 0) + int(wt)
+        frame = encode_snapshot(
+            _snap(node=f"n{i}", epoch=5, arrays=_sketch_arrays(keys, ws))
+        )
+        assert agg.ingest(frame)
+    assert agg.epochs_merged == 1
+    r = agg.rollups[-1]
+    assert sorted(r["nodes"]) == ["n0", "n1", "n2"]
+    top_keys, top_counts = r["top_flow"]
+    got = {tuple(int(x) for x in row) for row in top_keys}
+    exact_top = sorted(exact, key=exact.get, reverse=True)[:8]
+    assert all(t in got for t in exact_top)  # heavy flows all recalled
+    # Exact cross-node totals (CMS noise bounded by width >> keys).
+    best = exact_top[0]
+    for row, cnt in zip(top_keys, top_counts):
+        if tuple(int(x) for x in row) == best:
+            assert int(cnt) >= exact[best]  # CMS never undercounts
+            assert int(cnt) <= exact[best] + 64 * 4
+            break
+    else:
+        pytest.fail("heaviest flow missing from cluster top-k")
+
+
+def test_aggregator_straggler_timeout_closes_without_dead_node():
+    agg = _agg(fleet_expected_nodes=3, fleet_straggler_timeout_s=0.2)
+    for i in range(2):  # third node is dead
+        assert agg.ingest(encode_snapshot(_snap(node=f"n{i}", epoch=9)))
+    assert agg.epochs_merged == 0  # quorum not met, not yet timed out
+    assert agg.poll(now=time.monotonic() + 1.0) == 1
+    assert agg.epochs_merged == 1
+    assert sorted(agg.rollups[-1]["nodes"]) == ["n0", "n1"]
+    assert agg.rollups[-1]["straggled"]
+
+
+def test_aggregator_drops_duplicate_late_and_mismatched():
+    m = get_metrics()
+    agg = _agg(fleet_expected_nodes=2)
+    assert agg.ingest(encode_snapshot(_snap(node="a", epoch=4)))
+    # Duplicate node within the open epoch.
+    assert not agg.ingest(encode_snapshot(_snap(node="a", epoch=4)))
+    # Seed mismatch vs the reference established by the first frame.
+    bad_seeds = dict(SEEDS, flow=999)
+    assert not agg.ingest(
+        encode_snapshot(_snap(node="b", epoch=4, seeds=bad_seeds))
+    )
+    # Close the epoch, then a late frame for it must drop.
+    assert agg.ingest(encode_snapshot(_snap(node="b", epoch=4)))
+    assert agg.epochs_merged == 1
+    assert not agg.ingest(encode_snapshot(_snap(node="c", epoch=4)))
+    assert not agg.ingest(encode_snapshot(_snap(node="c", epoch=3)))
+    # Garbage frame.
+    assert not agg.ingest(b"not a frame")
+
+
+def test_aggregator_epoch_history_bounds_open_buckets():
+    agg = _agg(fleet_expected_nodes=4, fleet_epoch_history=2)
+    for e in range(5):
+        agg.ingest(encode_snapshot(_snap(node="solo", epoch=e)))
+    # Overflowed epochs force-closed oldest-first; at most 2 stay open.
+    assert len(agg.stats()["open_epochs"]) <= 2
+    assert agg.epochs_merged >= 3
+
+
+def test_tenant_guardrails_shed_lowest_priority_and_cap_series():
+    agg = _agg(
+        fleet_expected_nodes=4,
+        fleet_max_tenants=2,
+        fleet_tenant_series_max=3,
+        fleet_topk_k=16,
+    )
+    rng = np.random.default_rng(11)
+    for i, (tenant, prio) in enumerate(
+        [("gold", 9), ("silver", 5), ("bronze", 1), ("gold", 9)]
+    ):
+        keys = rng.integers(0, 2**32, size=(32, 4), dtype=np.uint32)
+        w = rng.integers(10, 90, size=32).astype(np.float64)
+        agg.ingest(encode_snapshot(_snap(
+            node=f"n{i}", epoch=2, tenant=tenant, priority=prio,
+            arrays=_sketch_arrays(keys, w),
+        )))
+    assert agg.epochs_merged == 1
+    tenants = agg.rollups[-1]["tenants"]
+    # bronze (lowest priority) shed; gold + silver kept.
+    assert set(tenants) == {"gold", "silver"}
+    for tr in tenants.values():
+        assert len(tr["top_flows"][0]) <= 3  # series cap enforced
+    # Published label space respects the cap too.
+    m = get_metrics()
+    for metric in m.fleet_tenant_top_flows.collect():
+        per_tenant: dict[str, int] = {}
+        for sample in metric.samples:
+            t = sample.labels["tenant"]
+            per_tenant[t] = per_tenant.get(t, 0) + 1
+        for t, n in per_tenant.items():
+            assert n <= 3, (t, n)
+
+
+def test_aggregator_entropy_and_cardinality_from_merge():
+    agg = _agg(fleet_expected_nodes=2)
+    rng = np.random.default_rng(13)
+    for i in range(2):
+        keys = rng.integers(0, 2**32, size=(128, 4), dtype=np.uint32)
+        w = np.ones(128)
+        agg.ingest(encode_snapshot(
+            _snap(node=f"n{i}", epoch=1, arrays=_sketch_arrays(keys, w))
+        ))
+    r = agg.rollups[-1]
+    # 256 distinct random flows across the fleet.
+    assert 200 < r["distinct_flows"] < 320
+    # Uniform random sources: entropy well above zero.
+    assert r["entropy_bits"]["src_ip"] > 4.0
+    assert len(r["service_cardinality"]) > 0
+
+
+# -- engine integration ------------------------------------------------
+def test_engine_ships_snapshot_at_window_close():
+    from test_engine import mk_records, small_cfg
+
+    from retina_tpu.engine import SketchEngine
+
+    got: list[bytes] = []
+    done = threading.Event()
+
+    def capture(frame: bytes) -> None:
+        got.append(frame)
+        done.set()
+
+    from retina_tpu.events.synthetic import POD_NET
+
+    cfg = small_cfg(fleet_enabled=True, fleet_node_name="eng-test")
+    eng = SketchEngine(cfg)
+    assert eng._fleet_shipper is not None
+    eng._fleet_shipper._transport = capture
+    eng._fleet_shipper.start()
+    try:
+        # Identities make the synthetic pods "of interest" — without
+        # them the filter drops every event before the sketches.
+        eng.update_identities({POD_NET + i: i for i in range(1, 50)})
+        eng.step_records(mk_records(
+            64, src_pods=np.arange(64) % 49 + 1, dst_pods=np.full(64, 7)
+        ))
+        eng._close_window()
+        assert done.wait(30), "no fleet frame shipped after window close"
+        snap = decode_snapshot(got[0])
+        assert snap.node == "eng-test"
+        assert set(snap.arrays) == set(ARRAY_CATALOG)
+        # The closed window's traffic is in the shipped sketches.
+        assert int(snap.arrays["totals"][0]) > 0
+        assert (snap.arrays["flow_counts"] > 0).any()
+        # Seeds match the pipeline's per-family constants.
+        assert snap.seeds == SEEDS
+        # And the window close still ran (export dispatched BEFORE
+        # end_window, not instead of it).
+        eng._harvest_window()
+    finally:
+        eng._fleet_shipper.stop()

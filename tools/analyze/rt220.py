@@ -193,7 +193,8 @@ def check_program(ctxs: list[FileCtx], rep: Reporter, root: Path) -> None:
             if tok == PREFIX.rstrip("_"):
                 continue  # prose mention of the prefix itself
             if tok in ("networkobservability_adv",
-                       "networkobservability_sketch"):
+                       "networkobservability_sketch",
+                       "networkobservability_fleet"):
                 continue  # prose mention of a family prefix
             if tok not in doc_ok:
                 rep.add(doc_ctx, i, "RT223",
