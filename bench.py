@@ -207,7 +207,165 @@ def run(smoke: bool) -> dict:
     recall = hits / k
     out["extra"]["heavy_hitter_recall_at_50"] = recall
     log(f"recall@50 = {recall}")
+
+    # BASELINE configs 3-5 ride along with the device phase (they were
+    # tested but never benchmarked): cardinality, entropy-anomaly, and
+    # service-graph micro-benches on the same device/backend.
+    try:
+        out["extra"]["baseline_configs"] = run_baseline_configs(smoke)
+    except Exception as e:  # noqa: BLE001 — ride-along must not sink the headline
+        log(f"baseline configs 3-5 FAILED: {type(e).__name__}: {e}")
+        out["extra"]["baseline_configs"] = {
+            "error": f"{type(e).__name__}: {e}".splitlines()[0][:200]
+        }
     return out
+
+
+def run_baseline_configs(smoke: bool) -> dict:
+    """BASELINE configs 3-5 micro-benches (BASELINE.md §configs):
+
+    - Config 3: per-(reason,pod) HLL distinct-src cardinality with a
+      cross-node max-merge, scored by worst-group relative error.
+    - Config 4: streaming src-IP entropy window + EWMA anomaly flag on
+      a trafficgen-style burst trace (flag must fire on the burst and
+      stay quiet before it).
+    - Config 5: pod x pod service-graph top-k vs exact ground truth.
+
+    Each reports update throughput and its accuracy score; emitted
+    alongside the headline metric, never in its place."""
+    import jax
+    import jax.numpy as jnp
+
+    from retina_tpu.ops.entropy import AnomalyEWMA, EntropyWindow
+    from retina_tpu.ops.hyperloglog import HyperLogLog
+    from retina_tpu.ops.topk import HeavyHitterSketch
+
+    rng = np.random.default_rng(3)
+    batch = 1 << (12 if smoke else 16)
+    iters = 4 if smoke else 16
+    res: dict = {}
+
+    def _rate(fn, state, batches) -> tuple:
+        s = fn(state, batches[0])  # compile
+        jax.block_until_ready(jax.tree_util.tree_leaves(s)[0])
+        t0 = time.perf_counter()
+        for i in range(iters):
+            s = fn(s, batches[i % len(batches)])
+        jax.block_until_ready(jax.tree_util.tree_leaves(s)[0])
+        return s, iters * batch / (time.perf_counter() - t0)
+
+    # -- Config 3: per-(reason,pod) distinct-src HLL, merge-exact ------
+    groups = 16 if smoke else 64
+    distinct = 1 << (10 if smoke else 14)
+    srcs = rng.integers(0, distinct, size=(2, iters, batch)).astype(np.uint32)
+    grp = rng.integers(0, groups, size=(iters, batch)).astype(np.int32)
+    ones = jnp.ones((batch,), jnp.float32)
+    upd = jax.jit(
+        lambda h, b: h.update([b[0]], b[1], ones)
+    )
+    halves = []
+    for node in range(2):  # two "nodes", max-merged like a psum
+        batches = [
+            (jnp.asarray(srcs[node, i]), jnp.asarray(grp[i]))
+            for i in range(iters)
+        ]
+        h = HyperLogLog.zeros(groups, 10, seed=11)
+        h, hll_rate = _rate(upd, h, batches)
+        halves.append(h)
+    est = np.asarray(halves[0].merge(halves[1]).estimate())
+    err = 0.0
+    for g in range(groups):
+        truth = len(
+            set(srcs[0][grp == g].tolist()) | set(srcs[1][grp == g].tolist())
+        )
+        if truth:
+            err = max(err, abs(float(est[g]) - truth) / truth)
+    res["config3_hll_cardinality"] = {
+        "events_per_sec": round(hll_rate),
+        "groups": groups,
+        "max_rel_err": round(err, 4),
+        "ok": err <= 0.15,
+    }
+
+    # -- Config 4: entropy window + anomaly flag on a burst trace ------
+    n_win = 16
+    ent0 = EntropyWindow.zeros(1, 1 << 10, seed=12)
+    det = AnomalyEWMA.zeros(1)
+    flags = []
+    ent_rate = 0.0
+
+    @jax.jit
+    def ent_win(ent, det, col):
+        ent = ent.reset().update(
+            [col], jnp.zeros((batch,), jnp.int32), ones
+        )
+        det, flag, _z = det.observe(
+            ent.entropy_bits(), min_windows=8
+        )
+        return ent, det, flag
+
+    for wi in range(n_win):
+        if wi == n_win - 1:  # single-source flood: entropy collapses
+            col = jnp.full((batch,), 0x0A0A0A0A, jnp.uint32)
+        else:
+            col = jnp.asarray(
+                rng.integers(0, 1 << 16, size=batch).astype(np.uint32)
+            )
+        t0 = time.perf_counter()
+        ent0, det, flag = ent_win(ent0, det, col)
+        flag = bool(np.asarray(flag)[0])
+        ent_rate = batch / (time.perf_counter() - t0)
+        flags.append(flag)
+    res["config4_entropy_anomaly"] = {
+        "events_per_sec": round(ent_rate),
+        "windows": n_win,
+        "burst_flagged": flags[-1],
+        "false_positives": int(sum(flags[8:-1])),
+        "ok": flags[-1] and not any(flags[8:-1]),
+    }
+
+    # -- Config 5: pod x pod service-graph top-k ------------------------
+    pods = 256 if smoke else 2048
+    kk = 32
+    # Zipf-ish edge weights: a handful of hot service edges.
+    hot = rng.integers(0, pods, size=(kk, 2)).astype(np.uint32)
+    svc = HeavyHitterSketch.zeros(
+        2, depth=4, width=1 << 12, n_slots=1 << 10, seed=13
+    )
+    edge_batches = []
+    exact: dict = {}
+    for i in range(iters):
+        cold = rng.integers(0, pods, size=(batch - kk * 8, 2)).astype(np.uint32)
+        edges = np.concatenate([np.repeat(hot, 8, axis=0), cold])
+        w = np.concatenate([
+            np.repeat(rng.integers(50, 100, size=kk), 8),
+            np.ones(len(cold), np.int64),
+        ]).astype(np.float32)
+        for row, wt in zip(edges, w):
+            t = (int(row[0]), int(row[1]))
+            exact[t] = exact.get(t, 0) + float(wt)
+        edge_batches.append((
+            [jnp.asarray(edges[:batch, 0]), jnp.asarray(edges[:batch, 1])],
+            jnp.asarray(w[:batch]),
+        ))
+    svc_upd = jax.jit(lambda s, b: s.update(b[0], b[1]))
+    svc, svc_rate = _rate(svc_upd, svc, edge_batches)
+    keys, _counts = svc.table.top_k_host(kk * 2)
+    got = {tuple(int(x) for x in row) for row in keys}
+    true_top = sorted(exact, key=exact.get, reverse=True)[:kk]
+    svc_recall = sum(1 for t in true_top if t in got) / kk
+    res["config5_service_graph_topk"] = {
+        "events_per_sec": round(svc_rate),
+        "pods": pods,
+        "recall_at_32": round(svc_recall, 4),
+        "ok": svc_recall >= 0.9,
+    }
+    log(
+        "baseline configs: "
+        f"c3 hll err {err:.3f}, c4 burst_flagged {flags[-1]}, "
+        f"c5 recall {svc_recall:.2f}"
+    )
+    return res
 
 
 def _measure_link_bandwidth() -> float:
@@ -312,6 +470,19 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     cfg.api_server_addr = "127.0.0.1:0"
     cfg.enabled_plugins = ["packetparser"]
     cfg.event_source = "synthetic"
+    # AOT executable disk cache (parallel/telemetry.py): a warm rerun
+    # skips serialize/lower for the step + end-window programs; hit/miss
+    # counts ride the diag line and the result.
+    cfg.aot_cache_dir = os.environ.get(
+        "RETINA_AOT_CACHE_DIR", os.path.join(DEFAULT_CACHE_DIR, "aot")
+    )
+    # Heavy-key source selector (docs/sketches.md migration path):
+    # RETINA_BENCH_HEAVY_KEYS=invertible runs the e2e bench with the
+    # host flow dict absent from the hot path entirely.
+    hk = os.environ.get("RETINA_BENCH_HEAVY_KEYS", "")
+    if hk:
+        cfg.heavy_keys_source = hk
+        log(f"e2e: heavy_keys_source={hk}")
     # Chaos drills: the bench builds its Config directly (no
     # load_config env layering), so honor RETINA_FAULT_SPEC here —
     # e.g. feed.backpressure:press drives the overload controller for
@@ -460,6 +631,7 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         rb0 = m.readback_bytes._value.get()
         samp0 = m.events_sampled._value.get()
         shed0 = _shed_counts()
+        xf0 = m.transfer_seconds._sum.get()
         t0 = time.monotonic()
         lat: list[float] = []
         while time.monotonic() - t0 < dur:
@@ -479,6 +651,13 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
             "events": ev1 - ev0,
             "elapsed": elapsed,
             "lat": lat,
+            # Stall-attribution inputs: was the bucket-grid warm still
+            # running, and what share of the window's wall clock the
+            # proxy spent inside transfer RPCs.
+            "warm_done": eng.bucket_warm_done.is_set(),
+            "transfer_share": (
+                (m.transfer_seconds._sum.get() - xf0) / elapsed
+            ),
             # Per-window overload diagnostics: what the adaptive
             # controller did to KEEP this window's event count nonzero
             # (docs/operations.md §6). events_sampled is the
@@ -528,11 +707,28 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     # as-is.
     STALL_FLOOR = 1e6
 
+    def _stall_cause(w: dict) -> str | None:
+        """Attribute one stalled (sub-floor) window to its most likely
+        cause, in evidence order: bucket-grid warm still compiling in
+        the background > overload controller actively degrading >
+        transfer RPCs owning the window's wall clock > an outright
+        harness-transport outage (the proxy parked, nothing moved)."""
+        if w["rate"] >= STALL_FLOOR:
+            return None
+        if not w["warm_done"]:
+            return "warm"
+        if w["overload_state"] != "NOMINAL":
+            return f"overload:{w['overload_state']}"
+        if w["transfer_share"] >= 0.5:
+            return "transfer_stall"
+        return "transport_outage"
+
     while len(windows) < 7 and any(
         w["rate"] < STALL_FLOOR for w in windows
     ):
-        log("e2e: stall-episode window detected; measuring an extra "
-            "window")
+        causes = [c for c in map(_stall_cause, windows) if c]
+        log("e2e: stall-episode window detected "
+            f"(causes so far: {causes}); measuring an extra window")
         windows.append(measure_window())
     # Steady-state proxy occupancy over EXACTLY the measured span (the
     # whole-run sums would fold boot compiles and warm waits in).
@@ -574,11 +770,19 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     t.join(60)
 
     # Per-dispatch self-diagnostics: where a slow window's time went.
+    from retina_tpu.parallel.telemetry import aot_disk_cache_stats
+
+    aot = aot_disk_cache_stats()
     try:
         xf_s = m.transfer_seconds._sum.get()
         xf_n = sum(b.get() for b in m.transfer_seconds._buckets)
         st_s = m.device_step_seconds._sum.get()
         per_w = feed.get("per_worker", [])
+        log(
+            f"e2e: aot disk cache hits={aot['hits']} "
+            f"misses={aot['misses']} errors={aot['errors']} "
+            f"dir={cfg.aot_cache_dir}"
+        )
         log(
             f"e2e: diag transfers={xf_n:.0f} "
             f"avg_transfer={xf_s / max(xf_n, 1) * 1e3:.1f}ms "
@@ -672,8 +876,11 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         ],
         # Windows zeroed by harness-transport outage episodes (see the
         # classification comment above); the headline median runs over
-        # the non-stalled windows only.
+        # the non-stalled windows only. Every stalled window carries an
+        # attributed cause (warm / overload:<state> / transfer_stall /
+        # transport_outage) — never silently re-measured.
         "stalled_windows": n_stalled,
+        "stall_causes": [c for c in map(_stall_cause, windows) if c],
         # Median over the non-stalled windows only (the STALL_FLOOR
         # classification above): what the system sustains when the
         # harness tunnel behaves. Reported beside the unfiltered
@@ -706,6 +913,10 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         "readback_bytes": int(win["readback_bytes"]),
         "bottleneck": bottleneck,
         "host_path_events_per_sec": round(host_path_rate),
+        # AOT executable disk cache accounting (hits = programs loaded
+        # pre-lowered from cfg.aot_cache_dir; misses = lowered+saved).
+        "aot_cache": aot,
+        "heavy_keys_source": cfg.heavy_keys_source,
         # What the measured wire efficiency implies on a production PCIe
         # host (~8 GB/s nominal): the link stops binding and the host
         # feed path (combine/pack/partition, measured above) becomes the
@@ -775,9 +986,35 @@ def main() -> None:
                     help="multi-agent fleet rollup dryrun: 8 simulated "
                          "node agents ship sketch snapshots to one "
                          "aggregator; one is killed mid-run")
+    ap.add_argument("--invertible-dryrun", action="store_true",
+                    help="cluster key-recovery dryrun: nodes ship "
+                         "counter-only frames (no raw keys) and the "
+                         "aggregator decodes heavy-flow keys from the "
+                         "merged invertible sketch, through a forced "
+                         "SHEDDING episode")
     args = ap.parse_args()
     try:
-        if args.fleet_dryrun:
+        if args.invertible_dryrun:
+            from retina_tpu.fleet.dryrun import run_invertible_dryrun
+
+            res = run_invertible_dryrun(
+                nodes=4 if args.smoke else 6,
+                epochs=2 if args.smoke else 4,
+                log=log,
+            )
+            out = {
+                # Acceptance: keys recovered FROM SKETCH STATE must
+                # cover >= 95% of the exact heavy set, with priority
+                # tenants at full recall through the shedding episode.
+                "metric": "invertible_key_recall",
+                "value": res["recall_min"],
+                "unit": "recall",
+                "vs_baseline": round(res["recall_min"] / 0.95, 4),
+                "extra": res,
+            }
+            if not res["ok"]:
+                out["error"] = "invertible dryrun acceptance failed"
+        elif args.fleet_dryrun:
             from retina_tpu.fleet.dryrun import run_dryrun
 
             res = run_dryrun(

@@ -255,6 +255,39 @@ class Config:
     overload_shed_order: list[str] = dataclasses.field(
         default_factory=lambda: ["dns", "conntrack", "labels"]
     )
+    # Priority-tier lattice (runtime/overload.py row_tiers): rows whose
+    # src OR dst IP matches (ip & mask) == match form the per-(tenant,
+    # service) priority class — exempt from sampling, and routed into
+    # the invertible sketch's full-accuracy high-priority region.
+    # mask 0 disables the class.
+    overload_priority_ip_mask: int = 0
+    overload_priority_ip_match: int = 0
+
+    # --- invertible sketch (ops/invertible.py; heavy-key recovery) ---
+    # Where heavy-flow KEYS come from:
+    #   flowdict   — host flow-descriptor dictionary (the historical
+    #                path; serialized, unbounded-memory)
+    #   invertible — decode keys from device sketch state at window
+    #                close; the flow dict leaves the hot path entirely
+    #   both       — run both, report recovery recall/precision as
+    #                metrics (the migration validation mode)
+    heavy_keys_source: str = "flowdict"
+    # Sketch shape: D hash rows x W buckets x 160 bit planes (u32), per
+    # region. Update cost scales with D*B per row; decode with D*W*B.
+    invertible_depth: int = 2
+    invertible_width: int = 1 << 12
+    # High-priority region width (receives only priority-class rows —
+    # small because the priority class is small by construction).
+    invertible_hi_width: int = 1 << 9
+    # Decoded keys with a CMS estimate under this weight are rejected
+    # (noise floor for the recovered-key set).
+    invertible_min_weight: int = 0
+
+    # --- AOT executable disk cache (parallel/telemetry.py AotProgram) ---
+    # Persist AOT-compiled step/end-window executables keyed by (jax
+    # version, topology, config signature) so bucket-grid warm survives
+    # process restarts. "" disables (bench/deploy opt in).
+    aot_cache_dir: str = ""
 
     # --- fleet rollup tier (fleet/) ---
     # Node side: ship the window-close sketch export over the relay.
@@ -398,6 +431,38 @@ class Config:
                 raise ValueError(
                     f"{f} must be >= 0, got {getattr(self, f)}"
                 )
+        if self.heavy_keys_source not in ("flowdict", "invertible", "both"):
+            raise ValueError(
+                "heavy_keys_source must be 'flowdict', 'invertible' or "
+                f"'both', got {self.heavy_keys_source!r}"
+            )
+        if self.heavy_keys_source == "both" and not (
+            self.transfer_packed and self.wire_flow_dict
+        ):
+            raise ValueError(
+                "heavy_keys_source='both' validates the invertible decode "
+                "against the flow dict, which requires transfer_packed "
+                "and wire_flow_dict"
+            )
+        for f in ("invertible_width", "invertible_hi_width"):
+            v = getattr(self, f)
+            if v <= 0 or (v & (v - 1)):
+                raise ValueError(
+                    f"{f} must be a positive power of two, got {v}"
+                )
+        if self.invertible_depth < 1:
+            raise ValueError(
+                f"invertible_depth must be >= 1, got {self.invertible_depth}"
+            )
+        if self.invertible_min_weight < 0:
+            raise ValueError(
+                f"invertible_min_weight must be >= 0, "
+                f"got {self.invertible_min_weight}"
+            )
+        for f in ("overload_priority_ip_mask", "overload_priority_ip_match"):
+            v = getattr(self, f)
+            if not (0 <= v <= 0xFFFFFFFF):
+                raise ValueError(f"{f} must fit in u32, got {v}")
 
 
 _BOOL_TRUE = {"1", "true", "yes", "on"}

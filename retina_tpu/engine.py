@@ -83,6 +83,14 @@ def pipeline_config_from(cfg: Config) -> PipelineConfig:
             if cfg.enable_conntrack_metrics
             else "high"
         ),
+        # Invertible heavy-key recovery (ops/invertible.py): the sketch
+        # arrays live in device state whenever decode may be asked for.
+        enable_invertible=cfg.heavy_keys_source in ("invertible", "both"),
+        inv_depth=cfg.invertible_depth,
+        inv_width=cfg.invertible_width,
+        inv_hi_width=cfg.invertible_hi_width,
+        priority_ip_mask=cfg.overload_priority_ip_mask,
+        priority_ip_match=cfg.overload_priority_ip_match,
     )
 
 
@@ -116,7 +124,9 @@ class SketchEngine:
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
         self.mesh = Mesh(np.array(devs), ("data",))
-        self.sharded = ShardedTelemetry(self.pcfg, self.mesh)
+        self.sharded = ShardedTelemetry(
+            self.pcfg, self.mesh, aot_cache_dir=cfg.aot_cache_dir
+        )
         self.state = self.sharded.init_state()
         # Record batches are pre-placed with the step's input sharding
         # OUTSIDE the state lock, so the lock is held only for the async
@@ -158,9 +168,14 @@ class SketchEngine:
         # Host side assigns stable device-table slots; the device table
         # itself is created lazily ON device (zeros jit — a host-side
         # 48MB/device upload would saturate the link it exists to save).
+        # heavy_keys_source="invertible" removes the dictionary from the
+        # hot path ENTIRELY (ISSUE 7 / ROADMAP item 4): the wire falls
+        # back to packed full rows and heavy keys come from the window
+        # close invertible decode instead of host descriptor slots.
         self._flow_dict = (
             make_flow_dict(cfg.flow_dict_slots)
             if cfg.transfer_packed and cfg.wire_flow_dict
+            and cfg.heavy_keys_source != "invertible"
             else None
         )
         # v3 wire: known-flow rows are TWO u32 lanes — [id | packets <<
@@ -176,6 +191,19 @@ class SketchEngine:
         self._fd_id_bits = max(1, (cfg.flow_dict_slots - 1).bit_length())
         self._fd_pk_bits = 32 - self._fd_id_bits
         self._fd_lock = threading.Lock()
+        # heavy_keys_source="both": host-side per-key packet ground
+        # truth (forward-verdict packets by 4-column flow key), fed in
+        # _dispatch_flowdict under _fd_lock; the harvest thread scores
+        # the invertible decode against it (recall/precision metrics).
+        # Cumulative like the device sketches. None = not validating.
+        self._hk_counts: Optional[dict] = (
+            {} if cfg.heavy_keys_source == "both"
+            and self._flow_dict is not None else None
+        )  # guarded-by: self._fd_lock
+        # Latest decoded heavy-key set (harvest thread writes, readers
+        # via invertible_report()).
+        self._inv_lock = threading.Lock()
+        self._inv_last: Optional[dict] = None  # guarded-by: self._inv_lock
         import os as _os
 
         # Cached once: the trace flag is read on every dispatch.
@@ -1183,6 +1211,32 @@ class SketchEngine:
             cap_total,
         )
 
+    def _hk_account(self, rows: np.ndarray) -> None:  # runs-on: feed-worker*
+        """("both" mode) Fold one dispatch's forward-verdict packets
+        into the host ground-truth dict, keyed exactly like the device
+        invertible/flow sketches: (src_ip, dst_ip, ports, proto). Caller
+        holds self._fd_lock. Counts are post-sampling (unscaled) — under
+        SAMPLING the heavy/priority tiers are exempt, so ground truth
+        for keys at/above the heavy threshold stays exact."""
+        from retina_tpu.events.schema import VERDICT_FORWARDED
+
+        fwd = rows[:, F.VERDICT] == VERDICT_FORWARDED
+        if not fwd.any():
+            return
+        r = rows[fwd]
+        keys = np.stack(
+            [r[:, F.SRC_IP], r[:, F.DST_IP], r[:, F.PORTS],
+             r[:, F.META] >> np.uint32(24)],
+            axis=1,
+        ).astype(np.uint32)
+        pk = r[:, F.PACKETS].astype(np.uint64)
+        uniq, inv = np.unique(keys, axis=0, return_inverse=True)
+        sums = np.zeros(len(uniq), np.uint64)
+        np.add.at(sums, inv, pk)
+        hk = self._hk_counts
+        for kb, s in zip((u.tobytes() for u in uniq), sums):
+            hk[kb] = hk.get(kb, 0) + int(s)
+
     def _dispatch_flowdict(
         self, sb: "ShardedBatch", now_s: int, n_raw: int,
         sync: bool, record_metrics: bool,
@@ -1209,6 +1263,8 @@ class SketchEngine:
                 rows = sb.records[d, :nv]
                 ids, is_new = self._flow_dict.lookup_or_assign(rows)
                 per_dev.append((rows, ids, is_new))
+                if self._hk_counts is not None and len(rows):
+                    self._hk_account(rows)
             epoch = self._fd_epoch
             # Snapshot here so the published gauges are consistent with
             # THIS batch's assignments (and no second lock acquisition
@@ -1780,6 +1836,9 @@ class SketchEngine:
                         "anomaly": host[1],
                         "zscore": host[2],
                     }, meta)
+                    inv_dec = meta.pop("inv_decode", None)
+                    if inv_dec is not None:
+                        self._harvest_invertible(inv_dec)
             except Exception:
                 if self._count_error("harvest_readback"):
                     self.log.exception("window readback failed")
@@ -1789,6 +1848,52 @@ class SketchEngine:
                 # Superseded mid-item (the watchdog already spawned a
                 # replacement): bow out after finishing this one.
                 return
+
+    def _harvest_invertible(self, dec) -> None:  # runs-on: window-harvest
+        """Read back one window's invertible decode, dedupe (a key can
+        decode from up to D row-buckets), publish tpu_invertible_*
+        gauges, and — in "both" mode — score recall/precision against
+        the host flow-dict ground truth (_hk_account)."""
+        ok = np.asarray(fetch_on_device(dec["ok"]), bool)
+        keys = np.asarray(fetch_on_device(dec["keys"]))[ok]
+        est = np.asarray(fetch_on_device(dec["est"]))[ok]
+        tier = np.asarray(fetch_on_device(dec["tier"]))[ok]
+        if len(keys):
+            uniq, idx = np.unique(keys, axis=0, return_index=True)
+            keys, est, tier = uniq, est[idx], tier[idx]
+        m = get_metrics()
+        m.invertible_keys_recovered.set(len(keys))
+        with self._inv_lock:
+            self._inv_last = {"keys": keys, "est": est, "tier": tier}
+        if self._hk_counts is None:
+            return
+        thr = max(1, int(self.cfg.invertible_min_weight))
+        with self._fd_lock:
+            truth = dict(self._hk_counts)
+        heavy = {k for k, v in truth.items() if v >= thr}
+        rec = {k.tobytes() for k in keys}
+        if heavy:
+            m.invertible_recall.set(len(heavy & rec) / len(heavy))
+        if rec:
+            m.invertible_precision.set(
+                sum(1 for k in rec if truth.get(k, 0) >= thr) / len(rec)
+            )
+
+    def invertible_report(self) -> dict:
+        """Latest window's recovered heavy-key set (host arrays):
+        ``keys (N, 4) u32`` rows of (src_ip, dst_ip, ports, proto),
+        ``est (N,)`` CMS count estimates, ``tier (N,)`` (0 = main
+        region, 1 = priority region). Empty arrays before the first
+        decoded window or when invertible is disabled."""
+        with self._inv_lock:
+            last = self._inv_last
+        if last is None:
+            return {
+                "keys": np.zeros((0, 4), np.uint32),
+                "est": np.zeros((0,), np.uint32),
+                "tier": np.zeros((0,), np.uint32),
+            }
+        return dict(last)
 
     def _harvest_window(self, timeout: float | None = None) -> None:
         """Drain pending window readbacks (shutdown / tests): returns
@@ -1892,16 +1997,32 @@ class SketchEngine:
                         get_metrics().fleet_ship_errors.inc()
                         if self._count_error("fleet_export"):
                             self.log.exception("fleet export failed")
+                inv = None
+                if self.pcfg.enable_invertible:
+                    # Same before-end_window contract as the fleet
+                    # export: decode reads the closing window's sketch
+                    # state, end_window donates it. Pure dispatch; the
+                    # harvest thread does the blocking readback.
+                    try:
+                        inv = self.sharded.inv_decode(
+                            self.state, self.cfg.invertible_min_weight
+                        )
+                    except Exception:
+                        get_metrics().invertible_decode_failed.inc()
+                        if self._count_error("inv_decode"):
+                            self.log.exception("invertible decode failed")
                 self.state, win = self.sharded.end_window(
                     self.state, self._zthresh
                 )
-            return self._win_stack(win)
+            return self._win_stack(win), inv
 
-        stacked = run_on_device(close)
+        stacked, inv_dec = run_on_device(close)
         # Advance only after a SUCCESSFUL dispatch: if end_window
         # raised, the next tick must retry this window, not skip it
         # forever.
         self._closed_events_in = ingested
+        if inv_dec is not None:
+            meta["inv_decode"] = inv_dec
         self._ensure_harvest_thread()
         self._harvest_q.put(("win", stacked, meta))
         get_metrics().windows_closed.inc()

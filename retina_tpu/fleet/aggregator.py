@@ -45,6 +45,7 @@ from retina_tpu.metrics import get_metrics
 from retina_tpu.ops.countmin import CountMinSketch
 from retina_tpu.ops.entropy import EntropyWindow
 from retina_tpu.ops.hyperloglog import HyperLogLog
+from retina_tpu.ops.invertible import InvertibleSketch, decode_verified
 from retina_tpu.ops.topk import TopKTable
 from retina_tpu.pubsub import get_pubsub
 
@@ -304,16 +305,23 @@ class FleetAggregator:
         merged: dict[str, Any],
         seeds: dict[str, int],
         k: int,
+        candidates: np.ndarray | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
-        """Top-k of the union of every node's candidates, counted by the
-        summed CMS (exact cross-node totals up to CMS overestimate)."""
-        cand = []
-        for s in snaps:
-            keys = s.arrays.get(f"{fam}_keys")
-            counts = s.arrays.get(f"{fam}_counts")
-            if keys is None or counts is None:
-                continue
-            cand.append(keys[counts > 0])
+        """Top-k of the candidate set, counted by the summed CMS (exact
+        cross-node totals up to CMS overestimate). ``candidates``
+        defaults to the union of every node's shipped candidate tables;
+        with invertible snapshots the caller passes the keys DECODED
+        from merged sketch state instead — no node shipped them."""
+        if candidates is not None:
+            cand = [candidates.astype(np.uint32).reshape(-1, 4)]
+        else:
+            cand = []
+            for s in snaps:
+                keys = s.arrays.get(f"{fam}_keys")
+                counts = s.arrays.get(f"{fam}_counts")
+                if keys is None or counts is None:
+                    continue
+                cand.append(keys[counts > 0])
         if not cand:
             return np.zeros((0, 0), np.uint32), np.zeros((0,), np.uint64)
         union = np.unique(np.concatenate(cand, axis=0), axis=0)
@@ -329,6 +337,62 @@ class FleetAggregator:
         sel = est[order] > 0
         return union[order][sel], est[order][sel]
 
+    def _invertible_decode(
+        self, merged: dict[str, Any], seeds: dict[str, int]
+    ) -> dict[str, Any] | None:
+        """Recover CLUSTER-WIDE heavy keys from the merged invertible
+        arrays (ops/invertible.py), verified against the merged flow
+        CMS. The arrays are pure sums, so the fleet-summed sketch
+        decodes exactly like a single node's — keys that were too light
+        to decode on any one node surface once their cluster-wide
+        weight dominates a bucket, and no node shipped a raw key.
+        Returns sorted-descending ``keys (N, 4)``, ``est (N,)``,
+        ``tier (N,)`` (1 = priority region) plus per-source packet
+        attribution ``sources = (src_ips, packets)`` for DDoS
+        attribution; None when the epoch carried no invertible state."""
+        if "inv_flow_planes" not in merged or "flow_cms" not in merged:
+            return None
+        cms = CountMinSketch(
+            table=merged["flow_cms"], seed=int(seeds.get("flow", 0))
+        )
+        all_keys, all_est, all_tier = [], [], []
+        for region, tier in (("inv_flow", 0), ("inv_hi", 1)):
+            if f"{region}_planes" not in merged:
+                continue
+            inv = InvertibleSketch(
+                planes=jnp.asarray(merged[f"{region}_planes"]),
+                weights=jnp.asarray(merged[f"{region}_weights"]),
+                seed=int(seeds.get(region, 0)),
+            )
+            cols, est, ok = decode_verified(inv, cms)
+            okh = np.asarray(ok, bool)
+            keys = np.stack([np.asarray(c) for c in cols], axis=1)[okh]
+            all_keys.append(keys.astype(np.uint32))
+            all_est.append(np.asarray(est)[okh].astype(np.uint64))
+            all_tier.append(np.full(len(keys), tier, np.uint32))
+        if not all_keys:
+            return None
+        keys = np.concatenate(all_keys)
+        est = np.concatenate(all_est)
+        tier = np.concatenate(all_tier)
+        if len(keys):
+            # A key decodes from up to depth buckets per region.
+            uniq, idx = np.unique(keys, axis=0, return_index=True)
+            keys, est, tier = uniq, est[idx], tier[idx]
+            order = np.argsort(est)[::-1]
+            keys, est, tier = keys[order], est[order], tier[order]
+            srcs, sinv = np.unique(keys[:, 0], return_inverse=True)
+            spk = np.zeros(len(srcs), np.uint64)
+            np.add.at(spk, sinv, est)
+            sorder = np.argsort(spk)[::-1]
+            sources = (srcs[sorder], spk[sorder])
+        else:
+            sources = (
+                np.zeros((0,), np.uint32), np.zeros((0,), np.uint64)
+            )
+        return {"keys": keys, "est": est, "tier": tier,
+                "sources": sources}
+
     def _rollup(
         self,
         epoch: int,
@@ -343,11 +407,31 @@ class FleetAggregator:
             "nodes": [s.node for s in snaps],
             "window_s": snaps[0].window_s,
         }
-        # Cluster-wide heavy hitters per family.
+        inv = None
+        if "inv_flow_planes" in merged:
+            try:
+                inv = self._invertible_decode(merged, seeds)
+            except Exception:
+                get_metrics().fleet_invertible_decode_failed.inc()
+                if rate_limited("fleet.invdec"):
+                    self.log.exception("fleet invertible decode failed")
+        if inv is not None:
+            rollup["invertible"] = inv
+        # Cluster-wide heavy hitters per family. With invertible state
+        # in the epoch, the flow candidate set is the keys decoded from
+        # MERGED sketch arrays (nodes shipped no raw keys); otherwise
+        # it is the union of per-node candidate tables.
         for fam in _HH_FAMILIES:
             if f"{fam}_cms" not in merged:
                 continue
-            keys, counts = self._cluster_topk(fam, snaps, merged, seeds, k)
+            cand = (
+                inv["keys"]
+                if fam == "flow" and inv is not None and len(inv["keys"])
+                else None
+            )
+            keys, counts = self._cluster_topk(
+                fam, snaps, merged, seeds, k, candidates=cand
+            )
             rollup[f"top_{fam}"] = (keys, counts)
         # Per-service (per-pod) distinct-source cardinality.
         if "hll_src_per_pod" in merged:
@@ -382,11 +466,20 @@ class FleetAggregator:
         if "totals" in merged:
             rollup["totals"] = np.asarray(merged["totals"])
         # Per-tenant heavy hitters under the cardinality guardrails.
-        rollup["tenants"] = self._tenant_rollups(snaps, seeds)
+        rollup["tenants"] = self._tenant_rollups(
+            snaps, seeds,
+            inv_keys=(
+                inv["keys"]
+                if inv is not None and len(inv["keys"]) else None
+            ),
+        )
         return rollup
 
     def _tenant_rollups(
-        self, snaps: list[FleetSnapshot], seeds: dict[str, int]
+        self,
+        snaps: list[FleetSnapshot],
+        seeds: dict[str, int],
+        inv_keys: np.ndarray | None = None,
     ) -> dict[str, dict]:
         """Per-tenant flow top-k with the label-space guardrails: at
         most ``fleet_max_tenants`` tenants (lowest priority shed first),
@@ -425,6 +518,7 @@ class FleetAggregator:
             keys, counts = self._cluster_topk(
                 "flow", group, merged_cms, seeds,
                 min(int(cfg.fleet_topk_k), cap),
+                candidates=inv_keys,
             )
             if len(keys) > cap:  # defense in depth; min() above caps
                 m.fleet_series_capped.inc(len(keys) - cap)
@@ -447,6 +541,16 @@ class FleetAggregator:
         m.fleet_tenant_top_flows.clear()
         m.fleet_service_cardinality.clear()
         m.fleet_tenant_series.clear()
+        m.fleet_invertible_sources.clear()
+        inv = rollup.get("invertible")
+        if inv is not None:
+            m.fleet_invertible_keys.set(float(len(inv["keys"])))
+            srcs, spk = inv["sources"]
+            cap = max(0, int(self.cfg.fleet_topk_k))
+            for ip, pk in zip(srcs[:cap], spk[:cap]):
+                m.fleet_invertible_sources.labels(
+                    key=f"{int(ip):08x}"
+                ).set(float(pk))
         for fam, gauge in (("flow", m.fleet_top_flows),):
             pair = rollup.get(f"top_{fam}")
             if pair is None:

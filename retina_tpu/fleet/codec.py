@@ -59,6 +59,41 @@ ARRAY_CATALOG: dict[str, tuple[str, str]] = {
     "hll_src_per_pod": ("uint32", "uint8"),
     "entropy": ("float32", "float32"),
     "totals": ("uint32", "uint32"),
+    # Invertible sketch regions (ops/invertible.py): pure-sum bit-plane
+    # counters — the aggregator decodes cluster-wide heavy keys from the
+    # MERGED arrays, so no node ever ships raw keys.
+    "inv_flow_planes": ("uint32", "uint32"),
+    "inv_flow_weights": ("uint32", "uint32"),
+    "inv_hi_planes": ("uint32", "uint32"),
+    "inv_hi_weights": ("uint32", "uint32"),
+}
+
+# Sketch op class implementing each catalog array's merge — the RT225
+# lint rule keys off this: every DISTINCT class named here must have a
+# merge-associativity (and commutativity) property test under tests/,
+# or the rollup silently stops being order-independent when someone
+# edits a merge. ``None`` marks plain vector adds with no op class
+# (associative by construction). The aggregator's _merge_fn mirrors
+# these semantics via its name-pattern branches (hll_* -> max,
+# *_keys/*_counts -> semilattice fold, else sum).
+ARRAY_OP_CLASSES: dict[str, str | None] = {
+    "flow_cms": "retina_tpu.ops.countmin.CountMinSketch",
+    "flow_keys": "retina_tpu.ops.topk.TopKTable",
+    "flow_counts": "retina_tpu.ops.topk.TopKTable",
+    "svc_cms": "retina_tpu.ops.countmin.CountMinSketch",
+    "svc_keys": "retina_tpu.ops.topk.TopKTable",
+    "svc_counts": "retina_tpu.ops.topk.TopKTable",
+    "dns_cms": "retina_tpu.ops.countmin.CountMinSketch",
+    "dns_keys": "retina_tpu.ops.topk.TopKTable",
+    "dns_counts": "retina_tpu.ops.topk.TopKTable",
+    "hll_flows": "retina_tpu.ops.hyperloglog.HyperLogLog",
+    "hll_src_per_pod": "retina_tpu.ops.hyperloglog.HyperLogLog",
+    "entropy": "retina_tpu.ops.entropy.EntropyWindow",
+    "totals": None,
+    "inv_flow_planes": "retina_tpu.ops.invertible.InvertibleSketch",
+    "inv_flow_weights": "retina_tpu.ops.invertible.InvertibleSketch",
+    "inv_hi_planes": "retina_tpu.ops.invertible.InvertibleSketch",
+    "inv_hi_weights": "retina_tpu.ops.invertible.InvertibleSketch",
 }
 
 

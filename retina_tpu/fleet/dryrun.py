@@ -13,6 +13,15 @@ share").
 One agent is killed mid-run (``kill_after``): epochs after the kill
 must still close via the straggler timeout, never blocking on the dead
 node.
+
+:func:`run_invertible_dryrun` (``bench.py --invertible-dryrun``) is the
+key-RECOVERY variant: nodes ship ONLY counter arrays (flow CMS + the
+invertible bit planes, no candidate key tables at all), the aggregator
+decodes cluster-wide heavy keys from the merged sketch state, and the
+scorecard checks recall >= 0.95 against exact ground truth — including
+under a forced SHEDDING episode where background flows are 1-in-k
+sampled with Horvitz-Thompson rescale while priority-class flows ride
+the never-sampled full-accuracy region and must keep recall 1.0.
 """
 
 from __future__ import annotations
@@ -29,8 +38,10 @@ import numpy as np
 from retina_tpu.config import Config
 from retina_tpu.fleet.aggregator import FleetAggregator
 from retina_tpu.fleet.shipper import SnapshotShipper
+from retina_tpu.ops.countmin import CountMinSketch
 from retina_tpu.ops.entropy import EntropyWindow
 from retina_tpu.ops.hyperloglog import HyperLogLog
+from retina_tpu.ops.invertible import InvertibleSketch
 from retina_tpu.ops.topk import HeavyHitterSketch
 
 # Sketch shapes for the simulated agents: small enough that 8+ agents
@@ -45,6 +56,13 @@ SEEDS = {
     "flow": 1, "svc": 2, "dns": 3,
     "hll_flows": 4, "hll_src_per_pod": 6, "entropy": 7,
 }
+
+# Invertible-dryrun shapes/seeds (mirror the engine's inv_flow/inv_hi
+# region split; sizes small enough to build a window in milliseconds).
+INV_SEEDS = dict(SEEDS, inv_flow=9, inv_hi=10)
+_INV_DEPTH = 2
+_INV_WIDTH = 1 << 9
+_INV_HI_WIDTH = 1 << 7
 
 
 def _sketch_arrays(keys: np.ndarray, w: np.ndarray) -> dict[str, np.ndarray]:
@@ -272,5 +290,198 @@ def run_dryrun(
         f"{straggled} straggled (node {shippers[victim].node} killed "
         f"after epoch {kill_after - 1}), tenant series "
         f"{series_obs}<={bound}"
+    )
+    return res
+
+
+def _invertible_arrays(
+    keys: np.ndarray, w: np.ndarray, is_pri: np.ndarray
+) -> dict[str, np.ndarray]:
+    """One node-window's COUNTER-ONLY wire arrays: flow CMS plus the two
+    invertible regions. Deliberately no ``flow_keys``/``flow_counts`` —
+    the whole point of ``--invertible-dryrun`` is that the frame carries
+    zero raw keys and the aggregator still names the heavy flows."""
+    cols = [jnp.asarray(keys[:, i]) for i in range(4)]
+    wv = jnp.asarray(w, jnp.uint32)
+    cms = CountMinSketch.zeros(
+        depth=_DEPTH, width=_WIDTH, seed=INV_SEEDS["flow"]
+    ).update(cols, wv)
+    pri = jnp.asarray(is_pri)
+    inv_flow = InvertibleSketch.zeros(
+        depth=_INV_DEPTH, width=_INV_WIDTH, seed=INV_SEEDS["inv_flow"]
+    ).update(cols, jnp.where(pri, 0, wv))
+    inv_hi = InvertibleSketch.zeros(
+        depth=_INV_DEPTH, width=_INV_HI_WIDTH, seed=INV_SEEDS["inv_hi"]
+    ).update(cols, jnp.where(pri, wv, 0))
+    return {
+        "flow_cms": np.asarray(cms.table),
+        "inv_flow_planes": np.asarray(inv_flow.planes),
+        "inv_flow_weights": np.asarray(inv_flow.weights),
+        "inv_hi_planes": np.asarray(inv_hi.planes),
+        "inv_hi_weights": np.asarray(inv_hi.weights),
+    }
+
+
+def run_invertible_dryrun(
+    nodes: int = 4,
+    epochs: int = 3,
+    shed_from: int = 1,
+    shed_k: int = 8,
+    heavy_flows: int = 32,
+    light_flows: int = 256,
+    priority_flows: int = 8,
+    seed: int = 0,
+    straggler_timeout_s: float = 1.0,
+    log: Callable[[str], None] = lambda s: None,
+) -> dict[str, Any]:
+    """Cluster key-recovery dryrun (see module doc). Epochs at or past
+    ``shed_from`` run a forced SHEDDING episode: background (light)
+    flows are 1-in-``shed_k`` sampled with Horvitz-Thompson weight
+    rescale — exactly the overload controller's degraded-accuracy
+    contract — while heavy and priority-class flows stay exempt per the
+    priority-tier lattice. Scorecard: heavy-key recall >= 0.95 every
+    epoch, priority recall == 1.0 INCLUDING shedding epochs."""
+    assert nodes >= 2 and epochs >= 1
+    rng = np.random.default_rng(seed)
+    base = Config(
+        fleet_enabled=True,
+        fleet_aggregator=True,
+        fleet_expected_nodes=nodes,
+        fleet_straggler_timeout_s=straggler_timeout_s,
+        fleet_topk_k=64,
+    )
+    agg = FleetAggregator(base)
+    agg.start(subscribe=True)
+
+    # Global heavy flows (every node carries a share) + priority-class
+    # flows (src_ip in the 10.x/8 analog: top byte 0x0A).
+    heavy = rng.integers(0, 2**32, size=(heavy_flows, 4), dtype=np.uint32)
+    pri = rng.integers(0, 2**32, size=(priority_flows, 4), dtype=np.uint32)
+    pri[:, 0] = (pri[:, 0] & np.uint32(0x00FFFFFF)) | np.uint32(0x0A000000)
+
+    shippers: list[SnapshotShipper] = []
+    for i in range(nodes):
+        cfg_i = dataclasses.replace(
+            base,
+            fleet_node_name=f"inv{i:02d}",
+            fleet_tenant=f"tenant{i % 2}",
+            fleet_priority=i % 2,
+        )
+        s = SnapshotShipper(cfg_i)
+        s.start()
+        shippers.append(s)
+
+    # Prewarm the sketch-build jit grid at the real batch shape (same
+    # rationale as run_dryrun: cold compiles would straggle epoch 0).
+    n_rows = heavy_flows + priority_flows + light_flows
+    _invertible_arrays(
+        np.zeros((n_rows, 4), np.uint32),
+        np.ones(n_rows),
+        np.zeros(n_rows, bool),
+    )
+
+    epoch_interval = 0.25
+    t0 = time.monotonic()
+
+    def agent(i: int) -> None:
+        node_rng = np.random.default_rng(seed * 1000 + i)
+        ship = shippers[i]
+        for e in range(epochs):
+            wait = t0 + e * epoch_interval - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            hw = node_rng.integers(100, 200, size=heavy_flows)
+            # Priority flows are LIGHT on any one node — only the
+            # never-sampled hi region makes them recoverable.
+            pw = node_rng.integers(5, 15, size=priority_flows)
+            lkeys = node_rng.integers(
+                0, 2**32, size=(light_flows, 4), dtype=np.uint32
+            )
+            # Keep ambient light keys out of the priority class so the
+            # hi region holds exactly the priority flows.
+            lkeys[:, 0] |= np.uint32(0x80000000)
+            lw = node_rng.integers(1, 4, size=light_flows).astype(np.int64)
+            if e >= shed_from:
+                # Forced SHEDDING: background tier only, HT rescale.
+                keep = node_rng.random(light_flows) < 1.0 / shed_k
+                lw = np.where(keep, lw * shed_k, 0)
+            keys = np.concatenate([heavy, pri, lkeys])
+            w = np.concatenate([hw, pw, lw]).astype(np.int64)
+            is_pri = (keys[:, 0] >> 24) == 0x0A
+            ship.offer(
+                e, _invertible_arrays(keys, w, is_pri), 15.0,
+                dict(INV_SEEDS),
+            )
+
+    threads = [
+        threading.Thread(target=agent, args=(i,), name=f"inv-sim{i}")
+        for i in range(nodes)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    deadline = time.monotonic() + straggler_timeout_s * 4 + 60.0
+    while agg.epochs_merged < epochs and time.monotonic() < deadline:
+        time.sleep(0.05)
+    for s in shippers:
+        s.stop()
+    agg.stop()
+
+    # -- scorecard -----------------------------------------------------
+    rollups = list(agg.rollups)
+    heavy_set = {tuple(int(x) for x in row) for row in heavy}
+    pri_set = {tuple(int(x) for x in row) for row in pri}
+    recalls: dict[int, float] = {}
+    hi_recalls: dict[int, float] = {}
+    precisions: dict[int, float] = {}
+    for r in rollups:
+        e = r["epoch"]
+        inv = r.get("invertible")
+        if inv is None:
+            recalls[e] = hi_recalls[e] = precisions[e] = 0.0
+            continue
+        got = {tuple(int(x) for x in row) for row in inv["keys"]}
+        recalls[e] = (
+            len(heavy_set & got) / len(heavy_set) if heavy_set else 1.0
+        )
+        hi_recalls[e] = (
+            len(pri_set & got) / len(pri_set) if pri_set else 1.0
+        )
+        truth = heavy_set | pri_set
+        precisions[e] = len(truth & got) / max(len(got), 1)
+    recall = min(recalls.values()) if recalls else 0.0
+    hi_recall = min(hi_recalls.values()) if hi_recalls else 0.0
+    shed_epochs = [e for e in recalls if e >= shed_from]
+    res = {
+        "nodes": nodes,
+        "epochs": epochs,
+        "epochs_merged": agg.epochs_merged,
+        "recall_min": round(recall, 4),
+        "recall_per_epoch": {e: round(v, 4) for e, v in recalls.items()},
+        "hi_recall_min": round(hi_recall, 4),
+        "hi_recall_per_epoch": {
+            e: round(v, 4) for e, v in hi_recalls.items()
+        },
+        "precision_per_epoch": {
+            e: round(v, 4) for e, v in precisions.items()
+        },
+        "shed_from": shed_from,
+        "shed_k": shed_k,
+        "shed_epochs_scored": len(shed_epochs),
+        "frames_shipped": sum(s.shipped for s in shippers),
+        "raw_keys_on_wire": 0,  # structural: no *_keys arrays shipped
+        "ok": bool(
+            agg.epochs_merged >= epochs
+            and recall >= 0.95
+            and hi_recall >= 1.0
+            and len(shed_epochs) >= 1
+        ),
+    }
+    log(
+        f"invertible dryrun: {nodes} agents, "
+        f"{agg.epochs_merged}/{epochs} epochs merged, min recall "
+        f"{recall:.3f}, priority recall {hi_recall:.3f} "
+        f"(shedding from epoch {shed_from}, 1-in-{shed_k})"
     )
     return res

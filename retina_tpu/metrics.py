@@ -165,6 +165,21 @@ class Metrics:
         self.fleet_tenant_series = g(mn.FLEET_TENANT_SERIES, [mn.L_TENANT])
         self.fleet_series_capped = c(mn.FLEET_SERIES_CAPPED, [])
         self.fleet_tenants_shed = c(mn.FLEET_TENANTS_SHED, [])
+        # Invertible sketch decode (ops/invertible.py; see metric_names
+        # for semantics). Node side:
+        self.invertible_keys_recovered = g(mn.INVERTIBLE_KEYS_RECOVERED, [])
+        self.invertible_decode_failed = c(mn.INVERTIBLE_DECODE_FAILED, [])
+        self.invertible_recall = g(mn.INVERTIBLE_RECALL, [])
+        self.invertible_precision = g(mn.INVERTIBLE_PRECISION, [])
+        # Fleet side (cleared + re-published per epoch like the other
+        # keyed cluster families):
+        self.fleet_invertible_keys = g(mn.FLEET_INVERTIBLE_KEYS, [])
+        self.fleet_invertible_sources = g(
+            mn.FLEET_INVERTIBLE_SOURCES, [mn.L_KEY]
+        )
+        self.fleet_invertible_decode_failed = c(
+            mn.FLEET_INVERTIBLE_DECODE_FAILED, []
+        )
 
 
 _singleton: Metrics | None = None

@@ -40,7 +40,27 @@ from retina_tpu.models.identity import IdentityMap
 from retina_tpu.ops.conntrack import ConntrackTable
 from retina_tpu.ops.entropy import AnomalyEWMA, EntropyWindow
 from retina_tpu.ops.hyperloglog import HyperLogLog
+from retina_tpu.ops.invertible import InvertibleSketch
 from retina_tpu.ops.topk import HeavyHitterSketch
+
+
+def priority_class(
+    src_ip: jnp.ndarray,
+    dst_ip: jnp.ndarray,
+    mask: int,
+    match: int,
+) -> jnp.ndarray:
+    """(B,) bool: rows belonging to the configured high-priority
+    (tenant, service) class — either endpoint inside the priority
+    prefix. mask == 0 disables the class (nothing matches). MUST stay
+    bit-identical to the numpy mirror in runtime/overload.py
+    (`priority_class_np`): the host sampler exempts these rows and the
+    device step must agree or the Horvitz-Thompson rescale goes
+    biased."""
+    if mask == 0:
+        return jnp.zeros(src_ip.shape, bool)
+    m, v = np.uint32(mask), np.uint32(match)
+    return ((src_ip & m) == v) | ((dst_ip & m) == v)
 
 
 def _sum64(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -123,8 +143,28 @@ class PipelineConfig:
     # (bounded and cheap). Requires enable_conntrack; validated in
     # __post_init__.
     data_aggregation_level: str = "high"
+    # Invertible sketch (ops/invertible.py): recover heavy-flow keys
+    # from sketch state at window close (cfg.heavy_keys_source). Two
+    # instances: the main region takes every flow; a small dedicated
+    # high-priority region takes ONLY priority-class rows (below) —
+    # those rows are never host-sampled (runtime/overload.py lattice),
+    # so the region is full-accuracy whatever the overload state.
+    enable_invertible: bool = False
+    inv_depth: int = 2
+    inv_width: int = 1 << 12
+    inv_hi_width: int = 1 << 9
+    # High-priority (tenant, service) class: an endpoint IP matching
+    # (ip & priority_ip_mask) == priority_ip_match is priority traffic.
+    # 0 mask disables. Mirrors cfg.overload_priority_ip_mask/_match —
+    # host sampler and device step MUST share the predicate.
+    priority_ip_mask: int = 0
+    priority_ip_match: int = 0
 
     def __post_init__(self):
+        if self.inv_width & (self.inv_width - 1):
+            raise ValueError("inv_width must be a power of two")
+        if self.inv_hi_width & (self.inv_hi_width - 1):
+            raise ValueError("inv_hi_width must be a power of two")
         if self.data_aggregation_level not in ("low", "high"):
             raise ValueError(
                 f"data_aggregation_level must be low|high, "
@@ -165,6 +205,10 @@ class PipelineState:
     hll_src_per_pod: HyperLogLog  # distinct srcs per dst pod, G=P
     entropy: EntropyWindow  # G=3: src_ip, dst_ip, dst_port
     anomaly: AnomalyEWMA  # G=3 EWMA over window entropies
+    # Invertible 5-tuple sketches: main region + full-accuracy
+    # high-priority region (1-wide placeholders when disabled).
+    inv_flow: InvertibleSketch
+    inv_hi: InvertibleSketch
     conntrack: ConntrackTable
     # apiserver latency: match table tsval-hash -> send-time, + histogram.
     lat_key: jnp.ndarray  # (L,) uint32 match fingerprints
@@ -214,6 +258,16 @@ class TelemetryPipeline:
             hll_src_per_pod=HyperLogLog.zeros(c.n_pods, c.hll_pod_precision, seed=6),
             entropy=EntropyWindow.zeros(3, c.entropy_buckets, seed=7),
             anomaly=AnomalyEWMA.zeros(3),
+            inv_flow=InvertibleSketch.zeros(
+                c.inv_depth if c.enable_invertible else 1,
+                c.inv_width if c.enable_invertible else 1,
+                n_key_cols=4, seed=9,
+            ),
+            inv_hi=InvertibleSketch.zeros(
+                c.inv_depth if c.enable_invertible else 1,
+                c.inv_hi_width if c.enable_invertible else 1,
+                n_key_cols=4, seed=10,
+            ),
             conntrack=ConntrackTable.zeros(c.conntrack_slots, seed=8),
             lat_key=u(c.latency_slots),
             lat_ts=u(c.latency_slots),
@@ -257,10 +311,16 @@ class TelemetryPipeline:
         # unsampled and must not be rescaled. u32 saturating multiply —
         # a clamped row is already a massive heavy hitter.
         k = jnp.asarray(sample_k, jnp.uint32)
+        # Priority-class rows (the overload lattice's (tenant, service)
+        # tier) are exempt on the host and therefore never rescaled
+        # here; they also route to the dedicated invertible region.
+        is_priority = priority_class(
+            src_ip, dst_ip, c.priority_ip_mask, c.priority_ip_match
+        )
         if c.sample_exempt_packets > 0:
             exempt = (
                 packets >= np.uint32(c.sample_exempt_packets)
-            ) | ((col(F.TSVAL) | col(F.TSECR)) != 0)
+            ) | ((col(F.TSVAL) | col(F.TSECR)) != 0) | is_priority
             scale = jnp.where((k > 1) & ~exempt, k, np.uint32(1))
             lim = np.uint32(0xFFFFFFFF) // jnp.maximum(k, np.uint32(1))
             cap = np.uint32(0xFFFFFFFF)
@@ -416,6 +476,20 @@ class TelemetryPipeline:
         five = [src_ip, dst_ip, ports, proto]
         flow_w = rep_pkts if low else jnp.where(is_fwd, packets, 0)
         flow_hh = state.flow_hh.update(five, flow_w)
+        # Invertible key-recovery sketches ride the SAME keys and
+        # weights as flow_hh, so decode verification against its CMS is
+        # apples-to-apples. Priority rows go ONLY to the hi region:
+        # they are never host-sampled, so that region's counters are
+        # exact whatever the overload state (background noise can't
+        # even dilute its buckets).
+        inv_flow, inv_hi = state.inv_flow, state.inv_hi
+        if c.enable_invertible:
+            inv_flow = inv_flow.update(
+                five, jnp.where(is_priority, 0, flow_w)
+            )
+            inv_hi = inv_hi.update(
+                five, jnp.where(is_priority, flow_w, 0)
+            )
         pods_known = (src_pod > 0) & (dst_pod > 0)
         svc_w = jnp.where(
             pods_known, rep_pkts if low else jnp.where(is_fwd, packets, 0), 0
@@ -529,6 +603,8 @@ class TelemetryPipeline:
             hll_src_per_pod=hll_pod,
             entropy=ent,
             anomaly=state.anomaly,
+            inv_flow=inv_flow,
+            inv_hi=inv_hi,
             conntrack=ct,
             lat_key=lat_key,
             lat_ts=lat_ts,

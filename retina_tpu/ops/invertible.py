@@ -1,0 +1,239 @@
+"""Invertible sketch: recover heavy-flow KEYS from sketch state.
+
+The CM/top-k pair (ops/countmin.py, ops/topk.py) answers "how much"
+for keys somebody already knows; the candidate table knows keys only
+because it stores them verbatim, which is exactly what a fleet node
+must NOT ship (docs/fleet.md privacy posture) and what the host flow
+dict must not be asked to remember at line rate. An *invertible*
+sketch (arxiv 1910.10441; the bit-plane group-testing construction of
+Deltoid/reversible sketches) recovers the keys themselves from pure
+counter state:
+
+  planes  (D, W, B) u32  per-bucket, per-bit weighted counters:
+                         planes[d, w, b] += weight for every update
+                         whose key has bit b set
+  weights (D, W)    u32  total update weight per bucket
+
+B = 32*C key bits (C u32 key columns) + 32 checksum bits (a hash of
+the key columns, accumulated through the same planes). Every array is
+a plain sum — merges are elementwise adds, so the sketch psums across
+chips and sums across fleet nodes exactly like the CMS, and RFLT
+frames carry no raw keys.
+
+Decode is a fixed-shape, pure-JAX pass over all D*W buckets: a bucket
+where one key owns a strict majority of the weight yields every bit of
+that key by majority vote (planes[b] > weights - planes[b]); the
+decoded key is accepted only if (a) its recomputed checksum bits match
+the decoded checksum bits (32 bits) and (b) it re-hashes to the bucket
+it was decoded from (log2 W bits) — ~2^-44 false-accept per bucket.
+A heavy key needs a majority in just ONE of its D row buckets, so
+recovery survives substantial light-flow noise; counts are then taken
+from the verified CMS estimate, not the bucket weight (the bucket
+weight includes the noise).
+
+Priority tiers (arxiv 2509.07338) are handled by INSTANCING, not
+special cases: the pipeline routes priority-class rows into a second,
+small, full-accuracy sketch that the overload sampler never touches
+(models/pipeline.py, runtime/overload.py priority lattice).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.ops.hashing import hash_cols, reduce_range
+
+# Seed offset for the checksum plane: must differ from every row-index
+# seed so checksum bits are independent of bucket placement.
+CHECK_SEED = np.uint32(0x1C3A9F71)
+
+CHECK_BITS = 32
+
+
+def n_planes(n_key_cols: int) -> int:
+    """Total bit planes for C u32 key columns + the checksum plane."""
+    return 32 * n_key_cols + CHECK_BITS
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class InvertibleSketch:
+    """Bit-plane invertible sketch over C-column u32 keys."""
+
+    planes: jnp.ndarray  # (D, W, B) u32
+    weights: jnp.ndarray  # (D, W) u32
+    seed: int = 0
+
+    # -- pytree plumbing ----------------------------------------------
+    def tree_flatten(self):
+        return (self.planes, self.weights), (self.seed,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(planes=children[0], weights=children[1], seed=aux[0])
+
+    # -- construction -------------------------------------------------
+    @classmethod
+    def zeros(
+        cls,
+        depth: int = 2,
+        width: int = 1 << 12,
+        n_key_cols: int = 4,
+        seed: int = 0,
+    ) -> "InvertibleSketch":
+        assert width & (width - 1) == 0, "width must be a power of two"
+        b = n_planes(n_key_cols)
+        return cls(
+            planes=jnp.zeros((depth, width, b), jnp.uint32),
+            weights=jnp.zeros((depth, width), jnp.uint32),
+            seed=seed,
+        )
+
+    @property
+    def depth(self) -> int:
+        return int(self.planes.shape[0])
+
+    @property
+    def width(self) -> int:
+        return int(self.planes.shape[1])
+
+    @property
+    def n_key_cols(self) -> int:
+        return (int(self.planes.shape[2]) - CHECK_BITS) // 32
+
+    # -- kernel -------------------------------------------------------
+    def _indices(self, key_cols: list[jnp.ndarray]) -> jnp.ndarray:
+        """(R,) key columns -> (depth, R) bucket indices (CMS-style
+        per-row seeds, offset so rows are independent)."""
+        seeds = (
+            np.arange(1, self.depth + 1, dtype=np.uint32)
+            + np.uint32(self.seed)
+        ).reshape(self.depth, 1)
+        h = hash_cols([c[None, :] for c in key_cols], seeds)
+        return reduce_range(h, self.width)
+
+    def _bits(self, key_cols: list[jnp.ndarray]) -> jnp.ndarray:
+        """(R,) key columns -> (R, B) 0/1 bit matrix (key bits then
+        checksum bits)."""
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+        mats = [
+            (c.astype(jnp.uint32)[:, None] >> shifts[None, :])
+            & jnp.uint32(1)
+            for c in key_cols
+        ]
+        check = hash_cols(key_cols, CHECK_SEED + np.uint32(self.seed))
+        mats.append(
+            (check[:, None] >> shifts[None, :]) & jnp.uint32(1)
+        )
+        return jnp.concatenate(mats, axis=1)
+
+    def update(
+        self, key_cols: list[jnp.ndarray], weights: jnp.ndarray
+    ) -> "InvertibleSketch":
+        """Add ``weights`` (masked rows must carry weight 0) at the
+        keys: one flattened scatter-add per array, all depth rows at
+        once (the countmin.py batching idiom)."""
+        d, w, b = self.planes.shape
+        idx = self._indices(key_cols)  # (d, R)
+        wts = weights.astype(jnp.uint32)
+        flat_idx = (
+            idx + (jnp.arange(d, dtype=jnp.uint32) * jnp.uint32(w))[:, None]
+        ).reshape(-1)
+        vals = self._bits(key_cols) * wts[:, None]  # (R, B)
+        tiled = jnp.broadcast_to(vals[None], (d,) + vals.shape).reshape(-1, b)
+        new_planes = (
+            self.planes.reshape(-1, b)
+            .at[flat_idx]
+            .add(tiled, mode="drop", unique_indices=False)
+        )
+        flat_wts = jnp.broadcast_to(wts[None, :], idx.shape).reshape(-1)
+        new_weights = (
+            self.weights.reshape(-1)
+            .at[flat_idx]
+            .add(flat_wts, mode="drop", unique_indices=False)
+        )
+        return dataclasses.replace(
+            self,
+            planes=new_planes.reshape(d, w, b),
+            weights=new_weights.reshape(d, w),
+        )
+
+    def decode(self) -> tuple[list[jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+        """Recover majority keys from every bucket (fixed shape, jit
+        friendly): ``(key_cols [C arrays of (D*W,)], weight (D*W,),
+        ok (D*W,) bool)``. ``ok`` marks buckets whose decoded key
+        passed the checksum AND re-hashes to its own bucket; everything
+        else is noise and must be ignored by the caller."""
+        d, w, b = self.planes.shape
+        c = self.n_key_cols
+        # Majority per bit: planes[b] > weights - planes[b], all u32
+        # (planes[b] <= weights by construction, so no wraparound).
+        maj = self.planes > (self.weights[:, :, None] - self.planes)
+        shifts = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+        cols = [
+            jnp.sum(
+                maj[:, :, 32 * i: 32 * (i + 1)].astype(jnp.uint32)
+                * shifts[None, None, :],
+                axis=2,
+                dtype=jnp.uint32,
+            ).reshape(-1)
+            for i in range(c)
+        ]
+        check_dec = jnp.sum(
+            maj[:, :, 32 * c:].astype(jnp.uint32) * shifts[None, None, :],
+            axis=2,
+            dtype=jnp.uint32,
+        ).reshape(-1)
+        check_ok = check_dec == hash_cols(
+            cols, CHECK_SEED + np.uint32(self.seed)
+        )
+        rehash = self._indices(cols).reshape(d, -1)  # (d, d*w)
+        own_row = jnp.repeat(
+            jnp.arange(d, dtype=jnp.int32), w
+        )  # bucket i came from row i//w
+        own_idx = jnp.take_along_axis(
+            rehash, own_row[None, :], axis=0
+        )[0]
+        bucket_pos = jnp.tile(jnp.arange(w, dtype=jnp.uint32), d)
+        weight = self.weights.reshape(-1)
+        ok = (weight > 0) & check_ok & (own_idx == bucket_pos)
+        return cols, weight, ok
+
+    def merge(self, other: "InvertibleSketch") -> "InvertibleSketch":
+        """Elementwise add — associative, commutative, psum-able."""
+        if self.seed != other.seed:
+            raise ValueError(
+                f"invertible seed mismatch: {self.seed} != {other.seed}"
+            )
+        return dataclasses.replace(
+            self,
+            planes=self.planes + other.planes,
+            weights=self.weights + other.weights,
+        )
+
+    def reset(self) -> "InvertibleSketch":
+        return dataclasses.replace(
+            self,
+            planes=jnp.zeros_like(self.planes),
+            weights=jnp.zeros_like(self.weights),
+        )
+
+
+def decode_verified(
+    inv: InvertibleSketch,
+    cms,
+    min_weight: int = 0,
+) -> tuple[list[jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """Decode + verify against a CMS over the SAME key columns: the
+    reported count is the CMS point estimate (the bucket weight
+    overcounts by the bucket's noise share), and keys whose estimate
+    falls under ``min_weight`` are rejected. Returns ``(key_cols,
+    est (D*W,), ok (D*W,))`` — fixed shape; callers rank/filter."""
+    cols, _weight, ok = inv.decode()
+    est = cms.query(cols).astype(jnp.uint32)
+    ok = ok & (est >= jnp.uint32(min_weight))
+    return cols, jnp.where(ok, est, 0), ok

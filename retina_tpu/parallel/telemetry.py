@@ -21,6 +21,10 @@ hand, XLA inserts the collectives from the shardings.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import os
+import pickle
+import threading
 from functools import partial
 from typing import Any
 
@@ -31,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from retina_tpu.models.identity import IdentityMap
 from retina_tpu.models.pipeline import PipelineConfig, PipelineState, TelemetryPipeline
+from retina_tpu.ops.invertible import decode_verified
 from retina_tpu.ops.topk import TopKTable
 
 # jax >= 0.5 promotes shard_map to the top-level namespace and renames
@@ -46,6 +51,29 @@ else:  # pragma: no cover - depends on installed jax
         if "check_vma" in kw:
             kw["check_rep"] = kw.pop("check_vma")
         return _exp_shard_map(f, **kw)
+
+
+# On-disk AOT executable cache accounting (ROADMAP item 5: compile cost
+# swings 2.1s->96.1s and bucket-grid warm is 214s PER PROCESS — a disk
+# cache keyed on (jax version, topology, config signature) makes warm
+# cost survive restarts). Module-level so bench diag can report hit/miss
+# across every AotProgram instance in the process.
+_AOT_DISK_LOCK = threading.Lock()
+_AOT_DISK_STATS = {"hits": 0, "misses": 0, "errors": 0}
+
+
+def aot_disk_cache_stats() -> dict[str, int]:
+    """Process-wide disk-cache counters: ``hits`` (deserialized from
+    disk, compile skipped), ``misses`` (compiled + persisted),
+    ``errors`` (load/save attempts that failed; always fell back to a
+    fresh compile, never fatal)."""
+    with _AOT_DISK_LOCK:
+        return dict(_AOT_DISK_STATS)
+
+
+def _aot_disk_bump(field: str) -> None:
+    with _AOT_DISK_LOCK:
+        _AOT_DISK_STATS[field] += 1
 
 
 class AotProgram:
@@ -65,15 +93,28 @@ class AotProgram:
     ``donate_argnums`` declared on the wrapped jit carry through
     ``lower().compile()`` untouched. ``_cache_size()`` mirrors the
     private jit introspection hook the stability tests assert on.
+
+    When ``cache_dir`` is set, each compiled executable is additionally
+    persisted to disk via ``jax.experimental.serialize_executable``,
+    keyed by (jax version, backend topology, ``config_sig``, program
+    tag, input signature) — a later process with the same key skips XLA
+    compilation entirely. Every disk interaction is best-effort: any
+    failure (old jax without the API, unpicklable trees, corrupt file,
+    read-only dir) falls back to a fresh in-process compile.
     """
 
     def __init__(self, jitted, mesh: Mesh, sharded_spec,
-                 sharded_argnums: tuple[int, ...]):
+                 sharded_argnums: tuple[int, ...],
+                 cache_dir: str = "", tag: str = "prog",
+                 config_sig: str = ""):
         self._jitted = jitted
         self._mesh = mesh
         self._spec = sharded_spec
         self._sharded_argnums = frozenset(sharded_argnums)
         self._execs: dict[Any, Any] = {}
+        self._cache_dir = cache_dir
+        self._tag = tag
+        self._config_sig = config_sig
 
     def _signature(self, args) -> Any:
         leaves, treedef = jax.tree_util.tree_flatten(args)
@@ -84,7 +125,64 @@ class AotProgram:
             for leaf in leaves
         )
 
-    def _lower(self, args):
+    # -- disk layer ----------------------------------------------------
+    def _disk_path(self, key) -> str:
+        devs = self._mesh.devices.ravel()
+        topo = "{}:{}:{}".format(
+            jax.default_backend(), len(devs),
+            getattr(devs[0], "device_kind", "?"),
+        )
+        raw = "|".join(
+            (jax.__version__, topo, self._tag, self._config_sig, repr(key))
+        )
+        h = hashlib.sha256(raw.encode()).hexdigest()[:32]
+        return os.path.join(self._cache_dir, f"{self._tag}-{h}.aotx")
+
+    def _disk_load(self, path: str):
+        if not os.path.exists(path):
+            return None
+        try:
+            from jax.experimental import serialize_executable as se
+
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+            ex = se.deserialize_and_load(
+                payload["exe"], payload["in_tree"], payload["out_tree"]
+            )
+            _aot_disk_bump("hits")
+            return ex
+        except Exception:
+            # Best-effort by contract (stale jax, corrupt/truncated file,
+            # incompatible executable): fall back to a fresh compile.
+            _aot_disk_bump("errors")
+            return None
+
+    def _disk_save(self, path: str, ex) -> None:
+        try:
+            from jax.experimental import serialize_executable as se
+
+            payload_exe, in_tree, out_tree = se.serialize(ex)
+            os.makedirs(self._cache_dir, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                pickle.dump(
+                    {"exe": payload_exe, "in_tree": in_tree,
+                     "out_tree": out_tree},
+                    f,
+                )
+            os.replace(tmp, path)
+            _aot_disk_bump("misses")
+        except Exception:
+            # Persisting is an optimization only — never fail the step.
+            _aot_disk_bump("errors")
+
+    def _lower(self, args, key=None):
+        if self._cache_dir and key is not None:
+            path = self._disk_path(key)
+            ex = self._disk_load(path)
+            if ex is not None:
+                return ex
+
         def struct(i, leaf):
             sh = NamedSharding(
                 self._mesh,
@@ -100,13 +198,16 @@ class AotProgram:
             jax.tree.map(lambda leaf, i=i: struct(i, leaf), arg)
             for i, arg in enumerate(args)
         )
-        return self._jitted.lower(*specs).compile()
+        ex = self._jitted.lower(*specs).compile()
+        if self._cache_dir and key is not None:
+            self._disk_save(self._disk_path(key), ex)
+        return ex
 
     def __call__(self, *args):
         key = self._signature(args)
         ex = self._execs.get(key)
         if ex is None:
-            ex = self._lower(args)
+            ex = self._lower(args, key=key)
             self._execs[key] = ex
         return ex(*args)
 
@@ -121,17 +222,24 @@ class ShardedTelemetry:
     as (D, B, F) connection-partitioned batches (parallel/partition.py).
     """
 
-    def __init__(self, config: PipelineConfig, mesh: Mesh):
+    def __init__(self, config: PipelineConfig, mesh: Mesh,
+                 aot_cache_dir: str = ""):
         self.pipeline = TelemetryPipeline(config)
         self.mesh = mesh
         self.axes = tuple(mesh.axis_names)
         self.n_devices = mesh.size
         self._sharded_spec = P(self.axes)  # dim0 split over every mesh axis
+        self._aot_cache_dir = aot_cache_dir
+        # Config identity for the disk cache key: the dataclass repr
+        # covers every field that changes compiled code (widths, depths,
+        # feature toggles) deterministically.
+        self._config_sig = repr(config)
         self._step = None
         self._end_window = None
         self._snapshot = None
         self._snapshot_flat = None
         self._fleet_export = None
+        self._inv_decode = None
 
     # ------------------------------------------------------------------
     def init_state(self) -> PipelineState:
@@ -200,6 +308,8 @@ class ShardedTelemetry:
         return AotProgram(
             jax.jit(fn, donate_argnums=(0,)), self.mesh,
             self._sharded_spec, (0, 1, 2),
+            cache_dir=self._aot_cache_dir, tag="step",
+            config_sig=self._config_sig,
         )
 
     def step(
@@ -286,6 +396,8 @@ class ShardedTelemetry:
         return AotProgram(
             jax.jit(fn, donate_argnums=(0,)), self.mesh,
             self._sharded_spec, (0,),
+            cache_dir=self._aot_cache_dir, tag="endwin",
+            config_sig=self._config_sig,
         )
 
     def end_window(
@@ -391,6 +503,14 @@ class ShardedTelemetry:
             out["hll_src_per_pod"] = pmax(s.hll_src_per_pod.registers)
             out["entropy"] = psum(s.entropy.counts)
             out["totals"] = psum(s.totals)
+            if self.pipeline.config.enable_invertible:
+                # Pure sums: the aggregator's default sum-merge branch
+                # recovers cluster-wide keys from these without any node
+                # shipping raw keys (fleet/aggregator.py).
+                out["inv_flow_planes"] = psum(s.inv_flow.planes)
+                out["inv_flow_weights"] = psum(s.inv_flow.weights)
+                out["inv_hi_planes"] = psum(s.inv_hi.planes)
+                out["inv_hi_weights"] = psum(s.inv_hi.weights)
             return out
 
         fn = _shard_map(
@@ -422,7 +542,69 @@ class ShardedTelemetry:
             "hll_flows": int(state.hll_flows.seed),
             "hll_src_per_pod": int(state.hll_src_per_pod.seed),
             "entropy": int(state.entropy.seed),
+            "inv_flow": int(state.inv_flow.seed),
+            "inv_hi": int(state.inv_hi.seed),
         }
+
+    # ------------------------------------------------------------------
+    def _build_inv_decode(self):
+        ax = self.axes
+
+        def local_dec(state, min_weight):
+            s = jax.tree.map(lambda x: x[0], state)
+            psum = lambda x: jax.lax.psum(x, ax)
+            # Decode the UNION sketch (devices hold connection-disjoint
+            # shards, the arrays are pure sums) against the union CMS —
+            # same merge contract the fleet aggregator applies node-wide.
+            merged_cms = dataclasses.replace(
+                s.flow_hh.cms, table=psum(s.flow_hh.cms.table)
+            )
+
+            def region(inv, tier):
+                merged = dataclasses.replace(
+                    inv,
+                    planes=psum(inv.planes),
+                    weights=psum(inv.weights),
+                )
+                cols, est, ok = decode_verified(
+                    merged, merged_cms, min_weight=0
+                )
+                ok = ok & (est >= min_weight)
+                tiers = jnp.full(est.shape, tier, jnp.uint32)
+                return cols, jnp.where(ok, est, 0), ok, tiers
+
+            f_cols, f_est, f_ok, f_tier = region(s.inv_flow, 0)
+            h_cols, h_est, h_ok, h_tier = region(s.inv_hi, 1)
+            keys = jnp.stack(
+                [jnp.concatenate([a, b]) for a, b in zip(f_cols, h_cols)],
+                axis=1,
+            )  # (M, C) u32
+            return {
+                "keys": keys,
+                "est": jnp.concatenate([f_est, h_est]),
+                "ok": jnp.concatenate([f_ok, h_ok]),
+                "tier": jnp.concatenate([f_tier, h_tier]),
+            }
+
+        fn = _shard_map(
+            local_dec,
+            mesh=self.mesh,
+            in_specs=(self._sharded_spec, P()),
+            out_specs=P(),  # psum-merged inputs => replicated decode
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def inv_decode(self, state: PipelineState, min_weight=0) -> dict[str, Any]:
+        """Window-close invertible decode (fixed shape, async dispatch
+        like fleet_export — caller reads back off the proxy). Returns
+        device arrays: ``keys (M, C) u32``, ``est (M,)``, ``ok (M,)``,
+        ``tier (M,)`` (0 = main region, 1 = priority region); rows with
+        ``ok == False`` are noise. M = D*W_flow + D*W_hi; the same key
+        can decode from up to D buckets — hosts dedupe (np.unique)."""
+        if self._inv_decode is None:
+            self._inv_decode = self._build_inv_decode()
+        return self._inv_decode(state, jnp.asarray(min_weight, jnp.uint32))
 
     # ------------------------------------------------------------------
     def _build_snapshot_flat(self, state: PipelineState):

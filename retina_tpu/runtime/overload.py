@@ -54,6 +54,48 @@ STATE_NAMES = ("NOMINAL", "SAMPLING", "SHEDDING", "DEGRADED")
 # cheapest-to-lose first (docs/operations.md §6).
 SHED_STAGES = ("dns", "conntrack", "labels")
 
+# Priority-tier lattice (PSketch, arxiv 2509.07338): higher tiers are
+# exempt from sampling, and the invertible high-priority sketch region
+# (models/pipeline.py inv_hi) only ever sees TIER_PRIORITY rows — so
+# priority tenants keep exact counters while background degrades first.
+TIER_BACKGROUND = 0  # sampled 1-in-k under SAMPLING+
+TIER_PRIORITY = 1  # per-(tenant,service) priority class (IP mask match)
+TIER_HEAVY = 2  # heavy-hitter candidates (packet weight)
+TIER_CONTROL = 3  # apiserver latency probes / control lane
+
+
+def priority_class_np(
+    src_ip: np.ndarray, dst_ip: np.ndarray, mask: int, match: int
+) -> np.ndarray:
+    """Host mirror of models.pipeline.priority_class — the two MUST stay
+    bit-identical: the feed worker drops rows with this predicate and
+    the device step rescales survivors with the jnp twin; any skew
+    biases the Horvitz-Thompson estimate. mask == 0 disables the class
+    (no row is priority)."""
+    if mask == 0:
+        return np.zeros(src_ip.shape, bool)
+    m, v = np.uint32(mask), np.uint32(match)
+    return ((src_ip & m) == v) | ((dst_ip & m) == v)
+
+
+def row_tiers(rec: np.ndarray, cfg) -> np.ndarray:
+    """Classify combined rows into the priority lattice: (N,) uint8 of
+    TIER_* values, taking the HIGHEST tier each row qualifies for.
+    Exemption from sampling is simply ``tier > TIER_BACKGROUND``."""
+    tiers = np.zeros(rec.shape[0], np.uint8)
+    tiers[
+        priority_class_np(
+            rec[:, F.SRC_IP], rec[:, F.DST_IP],
+            int(getattr(cfg, "overload_priority_ip_mask", 0)),
+            int(getattr(cfg, "overload_priority_ip_match", 0)),
+        )
+    ] = TIER_PRIORITY
+    heavy = rec[:, F.PACKETS] >= np.uint32(cfg.overload_exempt_packets)
+    tiers[heavy] = TIER_HEAVY
+    control = (rec[:, F.TSVAL] | rec[:, F.TSECR]) != 0
+    tiers[control] = TIER_CONTROL
+    return tiers
+
 
 class OverloadController:
     """State machine + host-side sampler. Thread-safe; ``tick`` is called
@@ -83,6 +125,7 @@ class OverloadController:
         # Window-scoped accounting the engine snapshots+resets at close.
         self._win_sampled = 0  # events dropped  # guarded-by: self._lock
         self._win_kept = 0  # events admitted  # guarded-by: self._lock
+        self._win_priority = 0  # priority-tier events  # guarded-by: self._lock
 
     # -- state machine -------------------------------------------------
     def tick(self, now: float | None = None) -> int:  # runs-on: engine-dispatch
@@ -203,10 +246,12 @@ class OverloadController:
         row's packet weight is final: the device step recomputes the
         SAME exemption predicate over the same rows and scales the
         non-exempt survivors by k (models/pipeline.py), keeping every
-        packet-weighted estimate unbiased. Exempt (never sampled):
-        heavy-hitter candidates (packets >= overload_exempt_packets)
-        and apiserver latency probes (TSVAL/TSECR != 0); window ticks
-        never pass through here at all (control lane).
+        packet-weighted estimate unbiased. Exempt (never sampled): any
+        row above TIER_BACKGROUND in the priority lattice (row_tiers) —
+        heavy-hitter candidates (packets >= overload_exempt_packets),
+        apiserver latency probes (TSVAL/TSECR != 0), and the configured
+        per-(tenant,service) priority IP class; window ticks never pass
+        through here at all (control lane).
 
         Returns ``(kept_rows, k)`` where k is 1 when not sampling.
         """
@@ -219,8 +264,8 @@ class OverloadController:
                     self._win_kept += kept_ev
             return rec, 1
         pk = rec[:, F.PACKETS]
-        exempt = pk >= np.uint32(self.cfg.overload_exempt_packets)
-        exempt |= (rec[:, F.TSVAL] | rec[:, F.TSECR]) != 0
+        tiers = row_tiers(rec, self.cfg)
+        exempt = tiers > TIER_BACKGROUND
         idx = np.nonzero(~exempt)[0]
         # Under the lock: N feed workers sample concurrently, and an
         # unlocked += here loses increments against both sibling
@@ -245,9 +290,11 @@ class OverloadController:
             if debt:
                 m.accuracy_debt.inc(debt)
         kept_ev = int(kept[:, F.PACKETS].sum())
+        pri_ev = int(pk[tiers == TIER_PRIORITY].sum())
         with self._lock:
             self._win_sampled += dropped_ev
             self._win_kept += kept_ev
+            self._win_priority += pri_ev
         return kept, k
 
     def note_shed(self, stage: str, amount: int = 1) -> None:
@@ -262,14 +309,17 @@ class OverloadController:
         engine attaches this to every closed window (harvest item)."""
         with self._lock:
             sampled, kept = self._win_sampled, self._win_kept
+            priority = self._win_priority
             self._win_sampled = 0
             self._win_kept = 0
+            self._win_priority = 0
             total = sampled + kept
             return {
                 "overload_state": STATE_NAMES[self._state],
                 "sampled_fraction":
                     (sampled / total) if total else 0.0,
                 "events_sampled": sampled,
+                "priority_exempt_events": priority,
                 "shed": list(self.shed_stages()),
             }
 

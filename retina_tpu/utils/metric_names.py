@@ -171,6 +171,27 @@ FLEET_TENANT_SERIES = FLEET_PREFIX + "tenant_series"
 FLEET_SERIES_CAPPED = FLEET_PREFIX + "series_capped_counter"
 FLEET_TENANTS_SHED = FLEET_PREFIX + "tenants_shed_counter"
 
+# Invertible sketch (ops/invertible.py): heavy-flow keys recovered from
+# sketch state at window close. Node side (tpu_invertible_*):
+# keys_recovered is the last window's verified decoded-key count;
+# decode_failed counts decode dispatch errors; recall/precision are
+# scored against the host flow-dict ground truth and only published in
+# heavy_keys_source="both" validation mode. Fleet side
+# (fleet_invertible_*): keys_recovered is the last epoch's cluster-wide
+# decoded-key count from MERGED sketch state (no node shipped raw
+# keys); source_packets{key} attributes decoded heavy traffic to source
+# IPs (DDoS attribution, cleared+republished per epoch, <= fleet_topk_k
+# series); decode_failed counts merged-state decode errors.
+INVERTIBLE_KEYS_RECOVERED = PREFIX + "tpu_invertible_keys_recovered"
+INVERTIBLE_DECODE_FAILED = PREFIX + "tpu_invertible_decode_failed_counter"
+INVERTIBLE_RECALL = PREFIX + "tpu_invertible_recall"
+INVERTIBLE_PRECISION = PREFIX + "tpu_invertible_precision"
+FLEET_INVERTIBLE_KEYS = FLEET_PREFIX + "invertible_keys_recovered"
+FLEET_INVERTIBLE_SOURCES = FLEET_PREFIX + "invertible_source_packets"
+FLEET_INVERTIBLE_DECODE_FAILED = (
+    FLEET_PREFIX + "invertible_decode_failed_counter"
+)
+
 # Label keys (reference pkg/utils/metric_names.go label constants).
 L_DIRECTION = "direction"
 L_REASON = "reason"

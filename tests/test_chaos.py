@@ -382,6 +382,78 @@ def test_sampling_preserves_heavy_hitter_recall():
     assert 0.0 < ann["sampled_fraction"] < 1.0
 
 
+def test_invertible_priority_recall_under_shedding():
+    """Forced SHEDDING must not cost the priority class any recall:
+    rows in the configured priority prefix are tier-exempt from the
+    host sampler AND land in the never-sampled hi region of the
+    invertible sketch, so every priority flow decodes from the window
+    close at full weight — even when it is far too light to qualify as
+    a heavy-hitter candidate — while background traffic is shed 1-in-8
+    around it."""
+    cfg = small_cfg(
+        heavy_keys_source="invertible",
+        invertible_depth=2,
+        invertible_width=1 << 9,
+        invertible_hi_width=1 << 6,
+        invertible_min_weight=8,
+        cms_width=1 << 13,
+        overload_sample_k=8,
+        overload_priority_ip_mask=0xFFFFFF00,
+        overload_priority_ip_match=0x0B000000,
+        # Per-packet sketch weights: under AGG_LOW the same flow fed
+        # across quanta only counts when conntrack re-reports it, which
+        # would starve the repeated priority flows for reasons that have
+        # nothing to do with shedding.
+        data_aggregation_level="high",
+    )
+    eng = SketchEngine(cfg)
+    eng.update_identities({POD_NET + i: i for i in range(1, 50)})
+    eng.compile()
+    # Pin SHEDDING directly: no feed loop is running, so nothing ticks
+    # the controller back down.
+    eng.overload._state = ov.SHEDDING
+    assert eng.overload.sample_k == 8
+
+    pri_ips = (0x0B000000 + np.arange(12)).astype(np.uint32)
+    for _ in range(3):
+        pv = mk_records(12, src_pods=np.arange(12) + 1,
+                        dst_pods=np.full(12, 7))
+        pv[:, F.SRC_IP] = pri_ips
+        # Light on purpose: well under overload_exempt_packets (64) —
+        # only the priority tier keeps these rows out of the sampler.
+        pv[:, F.PACKETS] = 4
+        bg = mk_records(1500, src_pods=np.arange(1500) + 100,
+                        dst_pods=np.full(1500, 7))
+        rec = np.concatenate([pv, bg], axis=0)
+        for _kind, sb, now_s, n_raw in eng._build_quantum(
+            [rec], len(rec), int(time.time())
+        ):
+            assert sb.sample_k == 8
+            eng._dispatch_sharded(sb, now_s, n_raw=n_raw)
+
+    # Snapshot the window accounting BEFORE the close consumes it: the
+    # sampler really dropped background around the priority rows, and
+    # the annotation accounts their exempt weight.
+    ann = eng.overload.window_annotation()
+    assert ann["overload_state"] == "SHEDDING"
+    assert ann["events_sampled"] > 0
+    assert ann["priority_exempt_events"] >= 12 * 4 * 3
+
+    eng._close_window()
+    eng._harvest_window()
+    rep = eng.invertible_report()
+    got = {int(k[0]) for k in rep["keys"]}
+    missing = set(int(ip) for ip in pri_ips) - got
+    assert not missing, (
+        f"{len(missing)}/12 priority flows lost under SHEDDING"
+    )
+    # They decoded from the priority (hi) region, not by luck in main.
+    pri_rows = np.isin(
+        rep["keys"][:, 0], pri_ips.astype(rep["keys"].dtype)
+    )
+    assert (rep["tier"][pri_rows] == 1).all()
+
+
 def test_fleet_node_dropout_rollup_continues():
     """Fleet rollup chaos: one of the simulated node agents is killed
     mid-run. Every epoch must still merge — post-kill epochs close via

@@ -26,12 +26,13 @@ from retina_tpu.fleet import (
     encode_snapshot,
 )
 from retina_tpu.fleet.codec import ARRAY_CATALOG
-from retina_tpu.fleet.dryrun import SEEDS, _sketch_arrays
+from retina_tpu.fleet.dryrun import INV_SEEDS, SEEDS, _sketch_arrays
 from retina_tpu.fleet.shipper import window_epoch
 from retina_tpu.metrics import get_metrics
 from retina_tpu.ops.countmin import CountMinSketch
 from retina_tpu.ops.entropy import EntropyWindow
 from retina_tpu.ops.hyperloglog import HyperLogLog
+from retina_tpu.ops.invertible import InvertibleSketch
 from retina_tpu.ops.topk import HeavyHitterSketch, TopKTable
 
 
@@ -160,6 +161,15 @@ def _rand_topk(rng, seed=5):
     return s.update(keys, jnp.asarray(rng.integers(1, 100, 32), jnp.uint32))
 
 
+def _rand_inv(rng, seed=5):
+    s = InvertibleSketch.zeros(2, 1 << 6, seed=seed)
+    keys = [
+        jnp.asarray(rng.integers(0, 2**32, 32, dtype=np.uint32))
+        for _ in range(4)
+    ]
+    return s.update(keys, jnp.asarray(rng.integers(1, 50, 32), jnp.uint32))
+
+
 def _eq(a, b):
     import jax
 
@@ -171,8 +181,8 @@ def _eq(a, b):
 
 
 @pytest.mark.parametrize(
-    "mk", [_rand_cms, _rand_hll, _rand_entropy, _rand_topk],
-    ids=["cms", "hll", "entropy", "topk"],
+    "mk", [_rand_cms, _rand_hll, _rand_entropy, _rand_topk, _rand_inv],
+    ids=["cms", "hll", "entropy", "topk", "invertible"],
 )
 def test_merge_commutative(mk):
     rng = np.random.default_rng(1)
@@ -181,8 +191,8 @@ def test_merge_commutative(mk):
 
 
 @pytest.mark.parametrize(
-    "mk", [_rand_cms, _rand_hll, _rand_entropy, _rand_topk],
-    ids=["cms", "hll", "entropy", "topk"],
+    "mk", [_rand_cms, _rand_hll, _rand_entropy, _rand_topk, _rand_inv],
+    ids=["cms", "hll", "entropy", "topk", "invertible"],
 )
 def test_merge_associative(mk):
     rng = np.random.default_rng(2)
@@ -191,8 +201,8 @@ def test_merge_associative(mk):
 
 
 @pytest.mark.parametrize(
-    "mk", [_rand_cms, _rand_hll, _rand_topk],
-    ids=["cms", "hll", "topk"],
+    "mk", [_rand_cms, _rand_hll, _rand_topk, _rand_inv],
+    ids=["cms", "hll", "topk", "invertible"],
 )
 def test_merge_identity_on_zeros(mk):
     """merge with a fresh (zero) sketch is the identity — the aggregator
@@ -205,6 +215,7 @@ def test_merge_identity_on_zeros(mk):
             CountMinSketch: (2, 1 << 8),
             HyperLogLog: (2, 6),
             TopKTable: (2, 64),
+            InvertibleSketch: (2, 1 << 6),
         }[type(a)],
         seed=5,
     )
@@ -445,7 +456,13 @@ def test_engine_ships_snapshot_at_window_close():
 
     from retina_tpu.events.synthetic import POD_NET
 
-    cfg = small_cfg(fleet_enabled=True, fleet_node_name="eng-test")
+    # Invertible on so the shipped frame covers the FULL array catalog
+    # (the inv_* arrays only ship when the regions are allocated).
+    cfg = small_cfg(
+        fleet_enabled=True, fleet_node_name="eng-test",
+        heavy_keys_source="invertible",
+        invertible_width=1 << 8, invertible_hi_width=1 << 6,
+    )
     eng = SketchEngine(cfg)
     assert eng._fleet_shipper is not None
     eng._fleet_shipper._transport = capture
@@ -462,11 +479,13 @@ def test_engine_ships_snapshot_at_window_close():
         snap = decode_snapshot(got[0])
         assert snap.node == "eng-test"
         assert set(snap.arrays) == set(ARRAY_CATALOG)
-        # The closed window's traffic is in the shipped sketches.
+        # The closed window's traffic is in the shipped sketches —
+        # including the invertible regions the aggregator decodes.
         assert int(snap.arrays["totals"][0]) > 0
         assert (snap.arrays["flow_counts"] > 0).any()
+        assert (snap.arrays["inv_flow_weights"] > 0).any()
         # Seeds match the pipeline's per-family constants.
-        assert snap.seeds == SEEDS
+        assert snap.seeds == INV_SEEDS
         # And the window close still ran (export dispatched BEFORE
         # end_window, not instead of it).
         eng._harvest_window()

@@ -19,7 +19,7 @@ import sys
 import time
 from pathlib import Path
 
-from tools.analyze import generic, rt10x, rt200, rt210, rt220, rt230
+from tools.analyze import generic, rt10x, rt200, rt210, rt220, rt225, rt230
 from tools.analyze.core import (
     FileCtx,
     Finding,
@@ -41,7 +41,9 @@ DEFAULT_TARGETS = (
 )
 
 FILE_RULES = (generic.check, rt10x.check, rt200.check, rt210.check)
-PROGRAM_RULES = (rt220.check_program, rt230.check_program)
+PROGRAM_RULES = (
+    rt220.check_program, rt225.check_program, rt230.check_program,
+)
 
 RULE_FAMILIES = {
     "generic": "F401 F541 F601 F811 E711 E722 B006 B011 (+E999)",
@@ -58,6 +60,8 @@ RULE_FAMILIES = {
     "RT220": "metric registered but not declared (+RT221 literal "
              "metric name, RT222 undocumented series, RT223 doc "
              "mentions unknown series, RT224 declared-but-unused)",
+    "RT225": "fleet codec op class unresolvable or lacking a "
+             "merge-associativity property test",
     "RT230": "unknown cfg.<attr> access (+RT231 field never read, "
              "RT232 field undocumented)",
 }

@@ -1,0 +1,88 @@
+"""RT225 — sketch merge-associativity test coverage (whole-program).
+
+Every sketch op class named in the fleet codec's ``ARRAY_OP_CLASSES``
+catalog participates in the aggregator's batched merge; an op whose
+merge silently stops being associative/commutative makes the cluster
+rollup depend on node arrival order — a bug no unit test of a single
+merge call can see.  The contract: each DISTINCT class in the catalog
+must (a) resolve to a real class in the repo and (b) appear in at
+least one ``tests/`` file that defines a merge-associativity property
+test (a test function whose name contains ``associativ``).
+
+  RT225 catalog op class unresolvable, or with no merge-associativity
+        property test under tests/
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.analyze.core import FileCtx, Reporter
+
+CODEC_REL = "retina_tpu/fleet/codec.py"
+CATALOG_NAME = "ARRAY_OP_CLASSES"
+
+ASSOC_TEST_RE = re.compile(r"def test\w*associativ", re.IGNORECASE)
+
+
+def _catalog_classes(ctx: FileCtx) -> dict[str, int]:
+    """dotted class path -> first declaring lineno from the
+    ARRAY_OP_CLASSES dict literal (None values are plain vector adds,
+    associative by construction, and carry no class to test)."""
+    out: dict[str, int] = {}
+    for stmt in ctx.tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [stmt.target]
+            value = stmt.value
+        else:
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == CATALOG_NAME
+            for t in targets
+        ):
+            continue
+        if not isinstance(value, ast.Dict):
+            continue
+        for k, v in zip(value.keys, value.values):
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.setdefault(v.value, v.lineno)
+    return out
+
+
+def check_program(ctxs: list[FileCtx], rep: Reporter, root: Path) -> None:
+    by_rel = {c.rel: c for c in ctxs}
+    codec = by_rel.get(CODEC_REL)
+    if codec is None:
+        return
+    classes = _catalog_classes(codec)
+
+    # Test files that contain at least one associativity property test.
+    assoc_srcs = [
+        c.src for c in ctxs
+        if c.rel.startswith("tests/") and ASSOC_TEST_RE.search(c.src)
+    ]
+
+    for dotted, lineno in sorted(classes.items()):
+        mod, _, cls = dotted.rpartition(".")
+        mod_rel = mod.replace(".", "/") + ".py"
+        mod_ctx = by_rel.get(mod_rel)
+        if mod_ctx is None or not re.search(
+            rf"^class {re.escape(cls)}\b", mod_ctx.src, re.MULTILINE
+        ):
+            rep.add(codec, lineno, "RT225",
+                    f"catalog op class {dotted} does not resolve to a "
+                    "class in the repo",
+                    key=f"RT225:resolve:{dotted}")
+            continue
+        if not any(cls in src for src in assoc_srcs):
+            rep.add(codec, lineno, "RT225",
+                    f"catalog op class {dotted} has no "
+                    "merge-associativity property test under tests/ "
+                    "(a test named *associativ* must exercise it)",
+                    key=f"RT225:coverage:{dotted}")
