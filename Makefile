@@ -1,7 +1,8 @@
 # Developer entry points. CI runs the same commands
 # (.github/workflows/); the driver runs bench.py directly.
 
-.PHONY: test native bench bench-smoke soak distributed chaos lint clean
+.PHONY: test native bench bench-smoke soak distributed chaos lint \
+	analyze-device clean
 
 native:
 	$(MAKE) -C retina_tpu/native
@@ -39,6 +40,13 @@ distributed:
 lint:
 	python -m compileall -q retina_tpu tests tools bench.py __graft_entry__.py
 	python tools/lint.py
+
+# Device-program analysis (RT300 family): AOT-lowers every registered
+# @device_entry program on the CPU backend and checks merge algebra,
+# counter overflow, donation, replication and predicate parity.
+# Seconds, not milliseconds — separate target so `make lint` stays fast.
+analyze-device:
+	python tools/lint.py --device
 
 clean:
 	$(MAKE) -C retina_tpu/native clean

@@ -983,9 +983,13 @@ def main() -> None:
                     help="agent-overhead regression harness (loopback "
                          "workload with vs without the live agent)")
     ap.add_argument("--fleet-dryrun", action="store_true",
-                    help="multi-agent fleet rollup dryrun: 8 simulated "
+                    help="multi-agent fleet rollup dryrun: simulated "
                          "node agents ship sketch snapshots to one "
                          "aggregator; one is killed mid-run")
+    ap.add_argument("--fleet-agents", type=int, default=8,
+                    help="number of simulated node agents for "
+                         "--fleet-dryrun (default 8; the slow-tier "
+                         "test runs 100)")
     ap.add_argument("--invertible-dryrun", action="store_true",
                     help="cluster key-recovery dryrun: nodes ship "
                          "counter-only frames (no raw keys) and the "
@@ -1018,7 +1022,7 @@ def main() -> None:
             from retina_tpu.fleet.dryrun import run_dryrun
 
             res = run_dryrun(
-                nodes=8,
+                nodes=args.fleet_agents,
                 epochs=3 if args.smoke else 6,
                 kill_after=1 if args.smoke else 3,
                 log=log,

@@ -1,0 +1,131 @@
+"""Device-entry registry: the self-maintaining inventory of every
+jit / shard_map program the repo ships.
+
+The device-program analysis family (tools/analyze/rt300.py,
+docs/static-analysis.md RT300-RT305) AOT-lowers every registered entry
+point on a tiny synthetic CPU mesh and walks the jaxprs — merge
+algebra, counter-overflow intervals, donation coverage, replication
+audit. That only proves anything if the inventory is EXHAUSTIVE, so
+registration is enforced two ways:
+
+- **RT305 (AST, default lint):** every ``jax.jit`` / ``shard_map``
+  call site under ``retina_tpu/`` must sit inside a function carrying
+  ``@device_entry`` — an unregistered program fails the fast lint
+  before it can hide from the device pass.
+- **registry <-> recipe parity (``lint.py --device``):** every
+  registered name must have a lowering recipe in
+  ``tools/analyze/devlower.py`` and vice versa, so a new entry point
+  cannot be registered without also being analyzed.
+
+``device_entry`` is metadata-only: it records (name, kind, module,
+qualname, line) and returns the function unchanged — zero overhead on
+the hot path, no import-order constraints (this module imports nothing
+from the rest of the package at module scope).
+
+Kinds:
+- ``jit``       the function builds/returns/is a ``jax.jit`` program
+- ``shard_map`` the function builds a ``shard_map`` program
+- ``traced``    a pure function that only ever runs INSIDE another
+                registered program (the ops update/merge kernels) —
+                registered because the algebra/overflow passes analyze
+                it directly via ``jax.make_jaxpr``
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Callable
+
+VALID_KINDS = ("jit", "shard_map", "traced")
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceEntry:
+    """One registered device program (metadata only, no callable held
+    beyond what the analysis pass needs to locate the source)."""
+
+    name: str  # stable registry name, e.g. "pipeline.step"
+    kind: str  # "jit" | "shard_map" | "traced"
+    module: str
+    qualname: str
+    lineno: int
+
+
+# name -> entry.  Populated at import time of the entry modules;
+# load_registry() imports them all so the analysis pass (and tests)
+# always see the complete inventory.
+_REGISTRY: dict[str, DeviceEntry] = {}
+
+# Every module that registers entries.  The device pass imports these;
+# a module with a jit site that is NOT on this list is caught by RT305
+# (the call site has no @device_entry decorator in scope) long before
+# the device pass would miss it.
+ENTRY_MODULES = (
+    "retina_tpu.ops.countmin",
+    "retina_tpu.ops.topk",
+    "retina_tpu.ops.hyperloglog",
+    "retina_tpu.ops.entropy",
+    "retina_tpu.ops.invertible",
+    "retina_tpu.models.pipeline",
+    "retina_tpu.parallel.telemetry",
+    "retina_tpu.engine",
+    "retina_tpu.fleet.aggregator",
+)
+
+
+def device_entry(
+    name: str, kind: str = "jit"
+) -> Callable[[Callable[..., Any]], Callable[..., Any]]:
+    """Register ``fn`` as the device entry point ``name``.
+
+    Re-registering the same (module, qualname) under the same name is
+    idempotent (importlib.reload, doctest runners); two DIFFERENT
+    functions claiming one name is a hard error — silent shadowing is
+    exactly the inventory rot this registry exists to prevent.
+    """
+    if kind not in VALID_KINDS:
+        raise ValueError(
+            f"device_entry kind {kind!r} not in {VALID_KINDS}"
+        )
+
+    def deco(fn: Callable[..., Any]) -> Callable[..., Any]:
+        # fn may be an already-jitted wrapper (PjitFunction): take the
+        # source location from __wrapped__ where the wrapper lacks a
+        # __code__ of its own.
+        inner = getattr(fn, "__wrapped__", fn)
+        code = getattr(fn, "__code__", None) or getattr(
+            inner, "__code__", None
+        )
+        entry = DeviceEntry(
+            name=name,
+            kind=kind,
+            module=getattr(fn, "__module__", "?") or "?",
+            qualname=getattr(fn, "__qualname__", repr(fn)),
+            lineno=code.co_firstlineno if code is not None else 0,
+        )
+        prev = _REGISTRY.get(name)
+        if prev is not None and (prev.module, prev.qualname) != (
+            entry.module,
+            entry.qualname,
+        ):
+            raise ValueError(
+                f"device entry {name!r} registered twice: "
+                f"{prev.module}.{prev.qualname} and "
+                f"{entry.module}.{entry.qualname}"
+            )
+        _REGISTRY[name] = entry
+        try:
+            fn.__device_entry__ = name  # type: ignore[attr-defined]
+        except AttributeError:  # noqa: RT101 — C-level jit wrappers reject setattr; the tag is advisory, registration above already succeeded
+            pass
+        return fn
+
+    return deco
+
+
+def load_registry() -> dict[str, DeviceEntry]:
+    """Import every entry module and return the full inventory."""
+    for mod in ENTRY_MODULES:
+        importlib.import_module(mod)
+    return dict(_REGISTRY)

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from retina_tpu.config import Config
+from retina_tpu.devprog import device_entry
 from retina_tpu.events.schema import F, NUM_FIELDS
 from retina_tpu.fleet.shipper import window_epoch as fleet_epoch
 from retina_tpu.log import logger, rate_limited
@@ -928,6 +929,7 @@ class SketchEngine:
         self._dispatch_sharded(sb, now_s, n_raw=len(records),
                                record_metrics=record_metrics)
 
+    @device_entry("engine.ingest", kind="jit")
     def _ingest_fn(self, bucket: int, packed: bool):  # runs-on: device-proxy
         """Per-bucket jit that turns ONE transferred (D, bucket, P) wire
         array + a small metadata vector into step-ready device inputs:
@@ -962,7 +964,12 @@ class SketchEngine:
                 self._replicated,
             )
 
-            @_partial(jax.jit, out_shardings=out_sh)
+            # donate_argnums=(0,): the wire array is freshly device_put
+            # per flush and read exactly once here — donating it lets
+            # XLA reuse the transfer buffer for the unpacked windows
+            # instead of allocating a second (D, bucket, 16) block
+            # (RT302; found by the device-program donation audit).
+            @_partial(jax.jit, out_shardings=out_sh, donate_argnums=(0,))
             def ingest(small, meta):
                 if packed:
                     small = unpack_records_device(small, meta[0], meta[1])
@@ -1013,6 +1020,25 @@ class SketchEngine:
             self._fd_epoch += 1
             self._desc_table = None
 
+    @device_entry("engine.desc_table", kind="jit")
+    def _desc_table_fn(self):
+        """Zeros-on-device jit for the descriptor table (split from
+        _ensure_desc_table so the device-program analysis can lower
+        and audit the program without executing the ensure path)."""
+        from functools import partial as _partial
+
+        from retina_tpu.parallel.wire import PACKED_FIELDS
+
+        shape = (
+            self.n_devices, self.cfg.flow_dict_slots, PACKED_FIELDS,
+        )
+
+        @_partial(jax.jit, out_shardings=self._rec_sharding)
+        def mk():
+            return jnp.zeros(shape, jnp.uint32)
+
+        return mk
+
     def _ensure_desc_table(self):
         """(proxy thread) Device descriptor table, created by a zeros
         jit ON device — never uploaded from host. The jit build runs
@@ -1023,19 +1049,7 @@ class SketchEngine:
         with self._fd_lock:
             table = self._desc_table
         if table is None:
-            from functools import partial as _partial
-
-            from retina_tpu.parallel.wire import PACKED_FIELDS
-
-            shape = (
-                self.n_devices, self.cfg.flow_dict_slots, PACKED_FIELDS,
-            )
-
-            @_partial(jax.jit, out_shardings=self._rec_sharding)
-            def mk():
-                return jnp.zeros(shape, jnp.uint32)
-
-            table = mk()
+            table = self._desc_table_fn()()
             with self._fd_lock:
                 self._desc_table = table
         return table
@@ -1059,6 +1073,7 @@ class SketchEngine:
             )
         return tuple(wins), tuple(nvs)
 
+    @device_entry("engine.ingest_new", kind="jit")
     def _ingest_new_fn(self, bucket: int):  # runs-on: device-proxy
         """Per-bucket jit for NEW flow descriptors: (D, bucket, 13) wire
         of [table_id | 12 packed lanes] + meta + descriptor table ->
@@ -1088,8 +1103,13 @@ class SketchEngine:
                 self._rec_sharding,
             )
 
+            # donate (0, 2): the descriptor table (2) was always
+            # donated (scatter in place); the wire array (0) is also
+            # single-use per flush — fresh device_put, read once —
+            # so its transfer buffer is reusable too (RT302; found by
+            # the device-program donation audit).
             @_partial(
-                jax.jit, out_shardings=out_sh, donate_argnums=(2,)
+                jax.jit, out_shardings=out_sh, donate_argnums=(0, 2)
             )
             def ingest(wire, meta, table):
                 ids = wire[..., 0]
@@ -1123,6 +1143,7 @@ class SketchEngine:
             self._pad_cache[key] = fn
         return fn
 
+    @device_entry("engine.ingest_known", kind="jit")
     def _ingest_known_fn(self, bucket: int):  # runs-on: device-proxy
         """Per-bucket jit for KNOWN flows: (D, bucket, 2) wire of
         [table_id | packets << id_bits, bytes] + meta + descriptor
@@ -1164,7 +1185,11 @@ class SketchEngine:
                 self._replicated,
             )
 
-            @_partial(jax.jit, out_shardings=out_sh)
+            # donate_argnums=(0,): the (D, bucket, 2) counter wire is
+            # single-use per flush (RT302). The descriptor table (2)
+            # must NOT be donated: it is RESIDENT — the same buffer is
+            # read by every subsequent known-flow flush.
+            @_partial(jax.jit, out_shardings=out_sh, donate_argnums=(0,))
             def ingest(wire, meta, table):
                 ids = wire[..., 0] & id_mask
                 pk = wire[..., 0] >> id_bits

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from retina_tpu.devprog import device_entry
 from retina_tpu.fleet.codec import (
     ROLLUP_TOPIC, FleetDecodeError, FleetSnapshot, decode_snapshot,
 )
@@ -90,6 +91,11 @@ class FleetAggregator:
         # Rolling window of recent rollups for tests/dryrun/debug vars.
         self.rollups: list[dict] = []
         self.epochs_merged = 0
+        # High-water mark of concurrently-open epoch buckets; staying
+        # at or under cfg.fleet_epoch_history proves the overflow
+        # eviction never had to force-close an epoch (dryrun asserts
+        # this at 100-agent scale).
+        self.open_buckets_max = 0
 
     # -- lifecycle -----------------------------------------------------
     def start(self, subscribe: bool = True) -> None:
@@ -179,6 +185,8 @@ class FleetAggregator:
                 bucket = self._buckets[snap.epoch] = _EpochBucket(
                     time.monotonic()
                 )
+                if len(self._buckets) > self.open_buckets_max:
+                    self.open_buckets_max = len(self._buckets)
             if snap.node in bucket.snaps:
                 m.fleet_snapshots_dropped.labels(reason="duplicate").inc()
                 return False
@@ -228,6 +236,7 @@ class FleetAggregator:
         return len(ready)
 
     # -- merge ---------------------------------------------------------
+    @device_entry("fleet.merge", kind="jit")
     def _merge_fn(self, n: int, seeds: dict[str, int], names: tuple):
         key = (n, names, tuple(sorted(seeds.items())))
         fn = self._merge_cache.get(key)
@@ -259,7 +268,13 @@ class FleetAggregator:
                 out[kname], out[cname] = t.key_rows, t.counts
             return out
 
-        fn = jax.jit(merge)
+        # donate_argnums=(0,): `stacked` is built fresh per epoch in
+        # _merge_epoch (jnp.asarray of a host stack) and never read
+        # after this call — donating lets XLA fold the (n, ...) stacks
+        # into the reduction outputs instead of holding both the stack
+        # and the merged arrays live (RT302; found by the
+        # device-program donation audit).
+        fn = jax.jit(merge, donate_argnums=(0,))
         self._merge_cache[key] = fn
         return fn
 

@@ -118,15 +118,23 @@ def run_dryrun(
     heavy_flows: int = 40,
     light_flows: int = 192,
     seed: int = 0,
-    straggler_timeout_s: float = 1.0,
+    straggler_timeout_s: float | None = None,
     log: Callable[[str], None] = lambda s: None,
 ) -> dict[str, Any]:
     """Run the simulation; returns the scorecard dict (see module doc).
 
     ``kill_after``: the last agent stops shipping after this many epochs
     (node-dropout chaos); epochs 0..kill_after-1 close on full quorum.
+
+    ``straggler_timeout_s`` defaults to 0.1s per node (floor 1s): at
+    100 simulated agents the GIL serialises the per-node sketch
+    builds, so epoch-0 arrivals spread over seconds — a fixed 1s
+    timeout would close the bucket early and misreport full-quorum
+    epochs as straggled.
     """
     assert nodes >= 2 and epochs >= 1
+    if straggler_timeout_s is None:
+        straggler_timeout_s = max(1.0, 0.1 * nodes)
     rng = np.random.default_rng(seed)
     base = Config(
         fleet_enabled=True,
@@ -209,9 +217,12 @@ def run_dryrun(
         t.join()
     # Let the straggler timeout close the post-kill epochs. Generous
     # ceiling: the first n-node and (n-1)-node batched-merge programs
-    # compile cold here (seconds each); the loop exits as soon as every
+    # compile cold here (seconds each, and stack-width scales the
+    # compile with the fleet size); the loop exits as soon as every
     # epoch is merged, so healthy runs never wait this long.
-    deadline = time.monotonic() + straggler_timeout_s * 4 + 60.0
+    deadline = (
+        time.monotonic() + straggler_timeout_s * 4 + 60.0 + 2.0 * nodes
+    )
     while agg.epochs_merged < epochs and time.monotonic() < deadline:
         time.sleep(0.05)
     for s in shippers:
@@ -277,11 +288,14 @@ def run_dryrun(
         "tenants_seen": tenants_seen,
         "tenant_series_bound": bound,
         "tenant_series_max_observed": series_obs,
+        "epoch_history_bound": int(base.fleet_epoch_history),
+        "open_buckets_max": agg.open_buckets_max,
         "ok": bool(
             agg.epochs_merged >= epochs
             and recall >= 0.95
             and series_obs <= bound
             and tenants_seen <= base.fleet_max_tenants
+            and agg.open_buckets_max <= base.fleet_epoch_history
         ),
     }
     log(

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from retina_tpu.devprog import device_entry
 from retina_tpu.events.schema import (
     F,
     EV_DNS_REQ,
@@ -61,6 +62,53 @@ def priority_class(
         return jnp.zeros(src_ip.shape, bool)
     m, v = np.uint32(mask), np.uint32(match)
     return ((src_ip & m) == v) | ((dst_ip & m) == v)
+
+
+def sample_exempt(
+    packets: jnp.ndarray,
+    tsval: jnp.ndarray,
+    tsecr: jnp.ndarray,
+    is_priority: jnp.ndarray,
+    exempt_packets: int,
+) -> jnp.ndarray:
+    """(B,) bool: rows the host overload sampler keeps unsampled —
+    heavy-hitter candidates (packet weight >= the exemption
+    threshold), apiserver latency probes (TSVAL/TSECR lanes), and
+    priority-class rows. MUST stay bit-identical to the host tiering
+    in runtime/overload.py (``row_tiers`` > TIER_BACKGROUND): the
+    device step re-derives this predicate to decide which rows the
+    Horvitz-Thompson rescale may touch, and any disagreement biases
+    every packet-weighted estimate (RT304 sweeps the parity)."""
+    return (
+        (packets >= np.uint32(exempt_packets))
+        | ((tsval | tsecr) != 0)
+        | is_priority
+    )
+
+
+def ht_rescale(
+    packets: jnp.ndarray,
+    bytes_: jnp.ndarray,
+    exempt: jnp.ndarray,
+    sample_k,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Horvitz-Thompson re-weighting of a 1-in-k sampled batch:
+    multiply surviving NON-exempt rows by k so every packet-weighted
+    estimate stays unbiased. u32 saturating multiply — a row that
+    would wrap is clamped to the cap (it is already a massive heavy
+    hitter); RT301's interval analysis proves the non-saturated arm
+    cannot wrap under the documented per-row envelope."""
+    k = jnp.asarray(sample_k, jnp.uint32)
+    scale = jnp.where((k > 1) & ~exempt, k, np.uint32(1))
+    lim = np.uint32(0xFFFFFFFF) // jnp.maximum(k, np.uint32(1))
+    cap = np.uint32(0xFFFFFFFF)
+    packets = jnp.where(
+        (scale > 1) & (packets > lim), cap, packets * scale
+    )
+    bytes_ = jnp.where(
+        (scale > 1) & (bytes_ > lim), cap, bytes_ * scale
+    )
+    return packets, bytes_
 
 
 def _sum64(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -310,7 +358,6 @@ class TelemetryPipeline:
         # apiserver latency probes (TSVAL/TSECR lanes) were kept
         # unsampled and must not be rescaled. u32 saturating multiply —
         # a clamped row is already a massive heavy hitter.
-        k = jnp.asarray(sample_k, jnp.uint32)
         # Priority-class rows (the overload lattice's (tenant, service)
         # tier) are exempt on the host and therefore never rescaled
         # here; they also route to the dedicated invertible region.
@@ -318,17 +365,12 @@ class TelemetryPipeline:
             src_ip, dst_ip, c.priority_ip_mask, c.priority_ip_match
         )
         if c.sample_exempt_packets > 0:
-            exempt = (
-                packets >= np.uint32(c.sample_exempt_packets)
-            ) | ((col(F.TSVAL) | col(F.TSECR)) != 0) | is_priority
-            scale = jnp.where((k > 1) & ~exempt, k, np.uint32(1))
-            lim = np.uint32(0xFFFFFFFF) // jnp.maximum(k, np.uint32(1))
-            cap = np.uint32(0xFFFFFFFF)
-            packets = jnp.where(
-                (scale > 1) & (packets > lim), cap, packets * scale
+            exempt = sample_exempt(
+                packets, col(F.TSVAL), col(F.TSECR), is_priority,
+                c.sample_exempt_packets,
             )
-            bytes_ = jnp.where(
-                (scale > 1) & (bytes_ > lim), cap, bytes_ * scale
+            packets, bytes_ = ht_rescale(
+                packets, bytes_, exempt, sample_k
             )
         verdict = col(F.VERDICT)
         reason = jnp.minimum(col(F.DROP_REASON), np.uint32(c.n_drop_reasons - 1))
@@ -637,8 +679,10 @@ class TelemetryPipeline:
         return new, {"entropy_bits": h, "anomaly": flags, "zscore": z}
 
     # ------------------------------------------------------------------
+    @device_entry("pipeline.step", kind="jit")
     def jitted_step(self):
         return jax.jit(self.step, donate_argnums=(0,))
 
+    @device_entry("pipeline.end_window", kind="jit")
     def jitted_end_window(self):
         return jax.jit(self.end_window, donate_argnums=(0,))

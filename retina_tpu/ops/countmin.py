@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from retina_tpu.devprog import device_entry
 from retina_tpu.ops.hashing import hash_cols, reduce_range
 
 
@@ -76,6 +77,7 @@ class CountMinSketch:
         h = hash_cols([c[None, :] for c in key_cols], seeds)  # (depth, B)
         return reduce_range(h, self.width)
 
+    @device_entry("cms.update", kind="traced")
     def update(
         self, key_cols: list[jnp.ndarray], weights: jnp.ndarray
     ) -> "CountMinSketch":
@@ -104,6 +106,7 @@ class CountMinSketch:
         vals = jnp.take_along_axis(self.table, cols.astype(jnp.int32), axis=1)
         return jnp.min(vals, axis=0)
 
+    @device_entry("cms.merge", kind="traced")
     def merge(self, other: "CountMinSketch") -> "CountMinSketch":
         """CMS merge = elementwise add (the psum-able operation)."""
         return dataclasses.replace(self, table=self.table + other.table)
@@ -116,6 +119,7 @@ class CountMinSketch:
         return jnp.sum(self.table[0])
 
 
+@device_entry("cms.update_jit", kind="jit")
 @partial(jax.jit, donate_argnums=0)
 def cms_update_jit(
     sketch: CountMinSketch, key_cols: list[jnp.ndarray], weights: jnp.ndarray

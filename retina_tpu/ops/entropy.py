@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from retina_tpu.devprog import device_entry
 from retina_tpu.ops.hashing import hash_cols, reduce_range
 
 
@@ -49,6 +50,7 @@ class EntropyWindow:
     def n_buckets(self) -> int:
         return int(self.counts.shape[1])
 
+    @device_entry("entropy.update", kind="traced")
     def update(
         self,
         key_cols: list[jnp.ndarray],
@@ -73,6 +75,7 @@ class EntropyWindow:
         h = -jnp.sum(jnp.where(p > 0, p * jnp.log2(jnp.maximum(p, 1e-30)), 0.0), axis=1)
         return h
 
+    @device_entry("entropy.merge", kind="traced")
     def merge(self, other: "EntropyWindow") -> "EntropyWindow":
         return dataclasses.replace(self, counts=self.counts + other.counts)
 

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from retina_tpu.devprog import device_entry
 from retina_tpu.ops.hashing import hash_cols, reduce_range
 
 
@@ -67,6 +68,7 @@ class HyperLogLog:
     def m(self) -> int:
         return int(self.registers.shape[1])
 
+    @device_entry("hll.update", kind="traced")
     def update(
         self,
         key_cols: list[jnp.ndarray],
@@ -114,6 +116,7 @@ class HyperLogLog:
         use_lc = (raw <= 2.5 * m) & (zeros > 0)
         return jnp.where(use_lc, lc, raw)
 
+    @device_entry("hll.merge", kind="traced")
     def merge(self, other: "HyperLogLog") -> "HyperLogLog":
         return dataclasses.replace(
             self, registers=jnp.maximum(self.registers, other.registers)

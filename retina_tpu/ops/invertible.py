@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from retina_tpu.devprog import device_entry
 from retina_tpu.ops.hashing import hash_cols, reduce_range
 
 # Seed offset for the checksum plane: must differ from every row-index
@@ -131,6 +132,7 @@ class InvertibleSketch:
         )
         return jnp.concatenate(mats, axis=1)
 
+    @device_entry("inv.update", kind="traced")
     def update(
         self, key_cols: list[jnp.ndarray], weights: jnp.ndarray
     ) -> "InvertibleSketch":
@@ -203,6 +205,7 @@ class InvertibleSketch:
         ok = (weight > 0) & check_ok & (own_idx == bucket_pos)
         return cols, weight, ok
 
+    @device_entry("inv.merge", kind="traced")
     def merge(self, other: "InvertibleSketch") -> "InvertibleSketch":
         """Elementwise add — associative, commutative, psum-able."""
         if self.seed != other.seed:

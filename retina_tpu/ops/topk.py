@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from retina_tpu.devprog import device_entry
 from retina_tpu.ops.hashing import hash_cols, reduce_range
 from retina_tpu.ops.countmin import CountMinSketch
 
@@ -69,6 +70,7 @@ class TopKTable:
     def n_slots(self) -> int:
         return int(self.counts.shape[0])
 
+    @device_entry("topk.update", kind="traced")
     def update(
         self, key_cols: list[jnp.ndarray], estimates: jnp.ndarray
     ) -> "TopKTable":
@@ -102,6 +104,7 @@ class TopKTable:
         sel = counts[order] > 0
         return keys[order][sel], counts[order][sel]
 
+    @device_entry("topk.merge", kind="traced")
     def merge(self, other: "TopKTable") -> "TopKTable":
         """Join-semilattice slot merge for cross-node/device rollup.
 
@@ -170,6 +173,7 @@ class HeavyHitterSketch:
             table=TopKTable.zeros(n_key_cols, n_slots, seed=seed),
         )
 
+    @device_entry("hh.update", kind="traced")
     def update(
         self, key_cols: list[jnp.ndarray], weights: jnp.ndarray
     ) -> "HeavyHitterSketch":
@@ -178,6 +182,7 @@ class HeavyHitterSketch:
         est = jnp.where(weights > 0, est, 0)
         return HeavyHitterSketch(cms=cms, table=self.table.update(key_cols, est))
 
+    @device_entry("hh.merge", kind="traced")
     def merge(self, other: "HeavyHitterSketch") -> "HeavyHitterSketch":
         """CMS tables add; candidate tables join (see TopKTable.merge)."""
         return HeavyHitterSketch(

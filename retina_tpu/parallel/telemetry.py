@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from retina_tpu.devprog import device_entry
 from retina_tpu.models.identity import IdentityMap
 from retina_tpu.models.pipeline import PipelineConfig, PipelineState, TelemetryPipeline
 from retina_tpu.ops.invertible import decode_verified
@@ -50,7 +51,7 @@ else:  # pragma: no cover - depends on installed jax
     def _shard_map(f, **kw):
         if "check_vma" in kw:
             kw["check_rep"] = kw.pop("check_vma")
-        return _exp_shard_map(f, **kw)
+        return _exp_shard_map(f, **kw)  # noqa: RT305 — version shim, not a program site; callers carry @device_entry
 
 
 # On-disk AOT executable cache accounting (ROADMAP item 5: compile cost
@@ -242,7 +243,11 @@ class ShardedTelemetry:
         self._inv_decode = None
 
     # ------------------------------------------------------------------
-    def init_state(self) -> PipelineState:
+    @device_entry("sharded.init_state", kind="jit")
+    def _build_init_state(self):
+        """Builder split from init_state so the device-program analysis
+        (tools/analyze/rt300.py) can lower and audit the jit without
+        executing it."""
         single = jax.eval_shape(self.pipeline.init_state)
         d = self.n_devices
 
@@ -255,9 +260,13 @@ class ShardedTelemetry:
                 lambda s: jnp.zeros((d,) + s.shape, s.dtype), single
             )
 
-        return mk()
+        return mk
+
+    def init_state(self) -> PipelineState:
+        return self._build_init_state()()
 
     # ------------------------------------------------------------------
+    @device_entry("sharded.step", kind="shard_map")
     def _build_step(self):
         def local_step(
             state, records, n_valid, now_s, ident, apiserver_ip, filt, lost,
@@ -359,6 +368,7 @@ class ShardedTelemetry:
         )
 
     # ------------------------------------------------------------------
+    @device_entry("sharded.end_window", kind="shard_map")
     def _build_end_window(self):
         def local_end(state, z_thresh):
             s = jax.tree.map(lambda x: x[0], state)
@@ -408,6 +418,7 @@ class ShardedTelemetry:
         return self._end_window(state, jnp.asarray(z_thresh, jnp.float32))
 
     # ------------------------------------------------------------------
+    @device_entry("sharded.snapshot", kind="shard_map")
     def _build_snapshot(self):
         ax = self.axes
 
@@ -468,6 +479,7 @@ class ShardedTelemetry:
         return self._snapshot(state, jnp.asarray(now_s, jnp.uint32))
 
     # ------------------------------------------------------------------
+    @device_entry("sharded.fleet_export", kind="shard_map")
     def _build_fleet_export(self):
         ax = self.axes
         d = self.n_devices
@@ -547,6 +559,7 @@ class ShardedTelemetry:
         }
 
     # ------------------------------------------------------------------
+    @device_entry("sharded.inv_decode", kind="shard_map")
     def _build_inv_decode(self):
         ax = self.axes
 
@@ -607,6 +620,7 @@ class ShardedTelemetry:
         return self._inv_decode(state, jnp.asarray(min_weight, jnp.uint32))
 
     # ------------------------------------------------------------------
+    @device_entry("sharded.snapshot_flat", kind="jit")
     def _build_snapshot_flat(self, state: PipelineState):
         base = self._build_snapshot()
         shapes = jax.eval_shape(base, state, np.uint32(0))

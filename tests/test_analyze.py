@@ -24,6 +24,7 @@ from tools.analyze import (  # noqa: E402
     rt210,
     rt220,
     rt230,
+    rt300,
 )
 from tools.analyze.core import (  # noqa: E402
     FileCtx,
@@ -615,3 +616,297 @@ def test_driver_path_restriction_reports_subset(tmp_path, monkeypatch):
 def test_shipped_baseline_is_empty():
     from tools.analyze.core import load_baseline
     assert load_baseline(driver.BASELINE_PATH) == {}
+
+
+# ------------------------------------------------------- RT205 lock order
+
+LOCK_ORDER = """
+    import threading
+
+    class Supervisor:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self.x = 0
+
+        def worker(self):
+            with self._a:
+                with self._b:
+                    self.x = 1
+
+        def other(self):
+            with self._b:{noqa}
+                with self._a:
+                    self.x = 2
+"""
+
+
+def test_rt205_opposite_order_fires():
+    fs = run_rule(rt200.check, LOCK_ORDER.format(noqa=""))
+    assert "RT205" in codes(fs), fs
+    f = [x for x in fs if x.code == "RT205"][0]
+    assert "_a" in f.message and "_b" in f.message
+    assert "Supervisor" in f.key
+
+
+def test_rt205_same_order_no_fire():
+    src = """
+    import threading
+
+    class Supervisor:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self.x = 0
+
+        def worker(self):
+            with self._a:
+                with self._b:
+                    self.x = 1
+
+        def other(self):
+            with self._a:
+                with self._b:
+                    self.x = 2
+    """
+    assert "RT205" not in codes(run_rule(rt200.check, src))
+
+
+def test_rt205_noqa_on_reported_line():
+    # The finding anchors at the earliest witness site: the inner
+    # acquisition in `worker` (acquires _b while holding _a).
+    src = LOCK_ORDER.format(noqa="").replace(
+        "with self._b:\n",
+        "with self._b:  # noqa: RT205\n", 1)
+    assert "RT205" not in codes(run_rule(rt200.check, src))
+
+
+def test_rt205_cross_method_cycle_via_calls():
+    # Neither method nests two `with` blocks directly; the cycle only
+    # exists through the call graph (union-held-set propagation).
+    src = """
+    import threading
+
+    class Supervisor:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def _grab_b(self):
+            with self._b:
+                pass
+
+        def _grab_a(self):
+            with self._a:
+                pass
+
+        def fwd(self):
+            with self._a:
+                self._grab_b()
+
+        def rev(self):
+            with self._b:
+                self._grab_a()
+    """
+    assert "RT205" in codes(run_rule(rt200.check, src))
+
+
+def test_rt205_single_direction_no_fire():
+    src = """
+    import threading
+
+    class Supervisor:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def also_fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+    """
+    assert "RT205" not in codes(run_rule(rt200.check, src))
+
+
+# --------------------------------------------- RT305 registry coverage
+
+def test_rt305_unregistered_jit_fires():
+    src = """
+    import jax
+
+    def build():
+        return jax.jit(lambda x: x + 1)
+    """
+    fs = run_rule(rt300.check, src)
+    assert codes(fs) == ["RT305"], fs
+    assert "build" in fs[0].message
+
+
+def test_rt305_device_entry_covers_site():
+    src = """
+    import jax
+    from retina_tpu.devprog import device_entry
+
+    @device_entry("fake.build", kind="jit")
+    def build():
+        return jax.jit(lambda x: x + 1)
+    """
+    assert run_rule(rt300.check, src) == []
+
+
+def test_rt305_partial_jit_decorator():
+    # functools.partial(jax.jit, ...) creates the program too.
+    src = """
+    import jax
+    from functools import partial
+
+    def build():
+        step = partial(jax.jit, donate_argnums=(0,))(lambda s: s)
+        return step
+    """
+    assert "RT305" in codes(run_rule(rt300.check, src))
+
+
+def test_rt305_shard_map_fires_and_noqa():
+    src = """
+    from jax.experimental.shard_map import shard_map
+
+    def build(mesh):
+        return shard_map(lambda x: x, mesh=mesh)  # noqa: RT305
+    """
+    assert run_rule(rt300.check, src) == []
+    assert "RT305" in codes(
+        run_rule(rt300.check, src.replace("  # noqa: RT305", "")))
+
+
+def test_rt305_only_under_retina_tpu():
+    src = """
+    import jax
+
+    def helper():
+        return jax.jit(lambda x: x)
+    """
+    assert run_rule(rt300.check, src, rel="tools/whatever.py") == []
+    assert run_rule(rt300.check, src, rel="tests/t.py") == []
+
+
+# -------------------------------------------- interval engine (RT301)
+
+def _jaxpr(fn, *args):
+    import jax
+
+    return jax.make_jaxpr(fn)(*args)
+
+
+def test_interval_u32_add_wraps():
+    import jax.numpy as jnp
+
+    from tools.analyze.interval import analyze_jaxpr
+
+    j = _jaxpr(lambda a, b: a + b, jnp.uint32(0), jnp.uint32(0))
+    big = float(2 ** 31)
+    res = analyze_jaxpr(j, [(0.0, big), (0.0, big)])
+    assert res.wrapped and not res.unknown, res
+    assert not res.ok
+
+
+def test_interval_u32_add_in_range_ok():
+    import jax.numpy as jnp
+
+    from tools.analyze.interval import analyze_jaxpr
+
+    j = _jaxpr(lambda a, b: a + b, jnp.uint32(0), jnp.uint32(0))
+    res = analyze_jaxpr(j, [(0.0, 10.0), (0.0, 10.0)])
+    assert res.ok, res
+    assert res.out[0].hi == 20.0
+
+
+def test_interval_definite_branch_prunes():
+    # x <= 20 is definitely true for x in [0, 5]: the select must take
+    # the then-arm and the poison arm's huge range must NOT leak out.
+    import jax.numpy as jnp
+
+    from tools.analyze.interval import analyze_jaxpr
+
+    def f(x, y, z):
+        return jnp.where(x <= 20, y, z)
+
+    j = _jaxpr(f, jnp.uint32(0), jnp.uint32(0), jnp.uint32(0))
+    res = analyze_jaxpr(j, [(0.0, 5.0), (3.0, 4.0), (100.0, 200.0)])
+    assert res.ok, res
+    assert res.out[0].hi == 4.0, res.out
+
+
+def test_interval_scatter_add_wrap_and_ok():
+    import jax.numpy as jnp
+
+    from tools.analyze.interval import analyze_jaxpr
+
+    def f(t, u, idx):
+        return t.at[idx].add(u, mode="promise_in_bounds")
+
+    t = jnp.zeros(4, jnp.uint32)
+    u = jnp.zeros(2, jnp.uint32)
+    idx = jnp.zeros(2, jnp.int32)
+    j = _jaxpr(f, t, u, idx)
+    big = float(2 ** 31)
+    assert not analyze_jaxpr(
+        j, [(0.0, big), (0.0, big), (0.0, 1.0)]).ok
+    assert analyze_jaxpr(
+        j, [(0.0, 100.0), (0.0, 100.0), (0.0, 1.0)]).ok
+
+
+def test_interval_unknown_primitive_is_loud():
+    import jax.numpy as jnp
+
+    from tools.analyze.interval import analyze_jaxpr
+
+    j = _jaxpr(lambda x: jnp.sin(x), jnp.float32(0))
+    res = analyze_jaxpr(j, [(0.0, 1.0)])
+    assert "sin" in res.unknown
+    assert not res.ok
+
+
+def test_rt301_envelope_catches_inflated_traffic():
+    # The shipped envelope (tools/analyze/devlower.py) proves the
+    # hash-table rescale counters cannot wrap; feed the SAME real
+    # jaxpr an envelope 2^7 times larger and the wrap must be caught.
+    from tools.analyze import devlower
+    from tools.analyze.interval import analyze_jaxpr
+
+    jaxpr, intervals = devlower.ht_rescale_target()
+    res = analyze_jaxpr(jaxpr, [(float(a), float(b))
+                                for a, b in intervals])
+    assert res.ok, (res.wrapped, res.unknown)
+    inflated = [
+        (float(a), float(b) * 128.0) for a, b in intervals
+    ]
+    assert analyze_jaxpr(jaxpr, inflated).wrapped
+
+
+# ------------------------------------------ device pass finding paths
+
+def test_device_pass_findings_are_baselinable(tmp_path, monkeypatch):
+    # A device finding keyed on the entry name must suppress via
+    # baseline.json exactly like AST findings do.
+    from tools.analyze.core import Finding
+
+    monkeypatch.setattr(
+        driver, "BASELINE_PATH", tmp_path / "baseline.json")
+    fake = Finding(
+        path="retina_tpu/models/pipeline.py", line=1, code="RT302",
+        message="synthetic", key="RT302:pipeline.step:arg3")
+    monkeypatch.setattr(
+        driver, "analyze", lambda root=None, device=False: [fake])
+    out: list[str] = []
+    assert driver.run([], root=REPO, out=out.append) == 1
+    save_baseline(tmp_path / "baseline.json",
+                  {"RT302:pipeline.step:arg3": "reviewed: synthetic"})
+    out.clear()
+    assert driver.run([], root=REPO, out=out.append) == 0
+    assert any("1 baselined" in line for line in out)

@@ -1,0 +1,64 @@
+"""Slow-tier fleet scale test: the rollup holds at 100 simulated
+agents (ROADMAP item 3 headroom check).
+
+Runs the real ``bench.py --fleet-dryrun`` CLI — the exact command an
+operator would use — with ``--fleet-agents 100`` and asserts on the
+JSON scorecard it prints:
+
+- cluster top-k recall >= 0.95 through the mid-run node kill;
+- every epoch merged (the killed node never blocks the rollup);
+- NO aggregator epoch-history overflow: the high-water mark of
+  concurrently-open epoch buckets stays within
+  ``cfg.fleet_epoch_history``, i.e. the overflow eviction never had to
+  force-close an epoch at 100-agent scale.
+
+Excluded from tier 1 (``-m 'not slow'``): 100 agent threads plus the
+100-wide batched-merge compiles take minutes on a shared CPU host.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.slow
+
+FLEET_AGENTS = 100
+
+
+def test_fleet_dryrun_100_agents():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--fleet-dryrun",
+         "--fleet-agents", str(FLEET_AGENTS), "--smoke"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=1200,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    out = None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            out = json.loads(line)
+    assert out is not None, proc.stdout
+    assert "error" not in out, out
+    res = out["extra"]
+
+    assert res["nodes"] == FLEET_AGENTS, res
+    assert res["epochs_merged"] == res["epochs"], res
+    assert res["recall_min"] >= 0.95, res
+    # Post-kill epochs merged the 99 survivors via the straggler
+    # timeout — not a stale quorum, not a partial roster.
+    assert res["post_kill_nodes"], res
+    assert all(n == FLEET_AGENTS - 1 for n in res["post_kill_nodes"]), res
+    # No epoch-history overflow: open buckets never exceeded the bound,
+    # so no epoch was force-closed by the eviction path.
+    assert res["open_buckets_max"] <= res["epoch_history_bound"], res
+    assert res["ok"], res

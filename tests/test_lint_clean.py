@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
@@ -31,6 +32,32 @@ def test_repo_is_lint_clean():
     assert "0 finding(s)" in proc.stdout, proc.stdout
 
 
+def test_repo_is_device_finding_free():
+    """Tier-1 guard for the RT300 device pass: AOT-lowering every
+    registered entry point on the CPU backend completes well inside
+    its budget and surfaces zero findings — algebra, overflow,
+    donation, replication and registry parity all hold for the code
+    as shipped."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), "--device"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, (
+        "tools/lint.py --device found non-baselined findings:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+    assert "0 finding(s)" in proc.stdout, proc.stdout
+    assert elapsed < 60.0, (
+        f"device pass took {elapsed:.1f}s (budget 60s) — a recipe is "
+        "lowering something far bigger than the tiny synthetic mesh"
+    )
+
+
 def test_lint_runs_all_rule_families():
     proc = subprocess.run(
         [sys.executable, str(REPO / "tools" / "lint.py"),
@@ -42,5 +69,5 @@ def test_lint_runs_all_rule_families():
     )
     assert proc.returncode == 0
     for family in ("generic", "RT100", "RT101", "RT102", "RT200",
-                   "RT210", "RT220", "RT230"):
+                   "RT205", "RT210", "RT220", "RT230", "RT300"):
         assert family in proc.stdout, f"missing family {family}"

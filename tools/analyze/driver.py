@@ -19,7 +19,9 @@ import sys
 import time
 from pathlib import Path
 
-from tools.analyze import generic, rt10x, rt200, rt210, rt220, rt225, rt230
+from tools.analyze import (
+    generic, rt10x, rt200, rt210, rt220, rt225, rt230, rt300,
+)
 from tools.analyze.core import (
     FileCtx,
     Finding,
@@ -40,7 +42,9 @@ DEFAULT_TARGETS = (
     "__graft_entry__.py",
 )
 
-FILE_RULES = (generic.check, rt10x.check, rt200.check, rt210.check)
+FILE_RULES = (
+    generic.check, rt10x.check, rt200.check, rt210.check, rt300.check,
+)
 PROGRAM_RULES = (
     rt220.check_program, rt225.check_program, rt230.check_program,
 )
@@ -64,6 +68,15 @@ RULE_FAMILIES = {
              "merge-associativity property test",
     "RT230": "unknown cfg.<attr> access (+RT231 field never read, "
              "RT232 field undocumented)",
+    "RT205": "lock-acquisition order cycle (potential deadlock "
+             "between threads taking the same locks in opposite "
+             "order)",
+    "RT300": "[--device] merge algebra uses a non-associative/"
+             "commutative primitive, or registry/recipe inventory "
+             "drift (+RT301 u32 counter can wrap in-window, RT302 "
+             "donation coverage, RT303 unexpected collective, RT304 "
+             "host/device predicate divergence, RT305 unregistered "
+             "jit/shard_map site — RT305 runs in the default lint)",
 }
 
 
@@ -86,8 +99,13 @@ def parse_all(root: Path) -> list[FileCtx]:
     return ctxs
 
 
-def analyze(root: Path | None = None) -> list[Finding]:
-    """Run every rule over the default file set; no baseline applied."""
+def analyze(root: Path | None = None, device: bool = False) -> list[Finding]:
+    """Run every rule over the default file set; no baseline applied.
+
+    ``device=True`` additionally runs the RT300 device pass, which
+    imports jax (CPU backend) and AOT-lowers every registered device
+    entry point — seconds, not milliseconds, so it is opt-in
+    (``--device`` / ``make analyze-device``)."""
     root = root or REPO_ROOT
     ctxs = parse_all(root)
     rep = Reporter()
@@ -101,6 +119,8 @@ def analyze(root: Path | None = None) -> list[Finding]:
     good = [c for c in ctxs if c.syntax_error is None]
     for prule in PROGRAM_RULES:
         prule(good, rep, root)
+    if device:
+        rt300.check_device(good, rep, root)
     return rep.findings
 
 
@@ -111,6 +131,7 @@ def run(
 ) -> int:
     argv = list(argv or [])
     root = root or REPO_ROOT
+    device = "--device" in argv
     update_baseline = "--update-baseline" in argv
     if "--list-rules" in argv:
         for fam, desc in RULE_FAMILIES.items():
@@ -119,7 +140,7 @@ def run(
     path_args = [a for a in argv if not a.startswith("--")]
 
     t0 = time.monotonic()
-    findings = analyze(root)
+    findings = analyze(root, device=device)
 
     if path_args:
         # Restrict *reporting* to the requested paths; whole-program

@@ -18,6 +18,12 @@ the LOCKS held at each write, then:
   RT203 `# guarded-by:` names a lock that is not an attribute
         initialized in __init__
   RT204 malformed `# runs-on:` thread name
+  RT205 lock-acquisition order cycle: some path acquires lock B while
+        holding A and another acquires A while holding B — two threads
+        interleaving those paths deadlock.  Edges use the UNION of
+        possibly-held locks over call paths (any path creates an
+        ordering constraint); self-edges are RLock re-entrancy and are
+        skipped
 
 Thread attribution
 ------------------
@@ -99,6 +105,10 @@ class Method:
     escapes: list[tuple[int, str]] = dataclasses.field(
         default_factory=list)
     is_property: bool = False
+    # (acquired-lock, locks already held at the acquisition, lineno) —
+    # feeds the RT205 lock-acquisition order graph
+    acquires: list[tuple[str, frozenset[str], int]] = dataclasses.field(
+        default_factory=list)
 
 
 def _const_str(node: ast.expr | None) -> str | None:
@@ -204,6 +214,10 @@ class _ClassAnalysis:
                 for item in n.items:
                     ln = _lock_name(item.context_expr)
                     if ln is not None:
+                        # multi-item `with a, b:` acquires in order:
+                        # b's held-set already contains a (RT205)
+                        meth.acquires.append(
+                            (ln, frozenset(inner), n.lineno))
                         inner.append(ln)
                 for stmt in n.body:
                     visit(stmt, inner)
@@ -456,6 +470,104 @@ class _ClassAnalysis:
                 "declaration, or noqa with a reason",
                 key=f"RT200:{self.ctx.rel}:{self.cls.name}.{attr}",
                 also_noqa_lines=(decl_line,) if decl_line else ())
+
+        self._check_lock_order()
+
+    # -- RT205: lock-acquisition ordering -------------------------------
+    def _check_lock_order(self) -> None:
+        """Two threads taking the same locks in opposite orders can
+        deadlock. Build the acquired-while-holding graph (edge h -> l:
+        some path acquires l while holding h) and fail on any cycle.
+
+        Held-sets here are the UNION over call paths of possibly-held
+        locks (the RT200/RT201 fixpoint uses the INTERSECTION of
+        guaranteed-held locks — a lock must be held on EVERY path to
+        guard a write, but on ANY path to create an ordering edge).
+        Self-edges (re-acquiring the lock you hold) are RLock
+        re-entrancy, not an ordering problem — skipped."""
+        uentry: dict[str, frozenset[str]] = {
+            m: frozenset() for m in self.methods
+        }
+        for _ in range(len(self.methods) + 2):
+            changed = False
+            for mname, meth in self.methods.items():
+                for callee, site_locks in meth.calls:
+                    if callee not in self.methods:
+                        continue
+                    add = uentry[mname] | site_locks
+                    if not add <= uentry[callee]:
+                        uentry[callee] = uentry[callee] | add
+                        changed = True
+            if not changed:
+                break
+
+        # edge (held -> acquired) -> first witness site
+        edges: dict[tuple[str, str], tuple[str, int]] = {}
+        for mname, meth in self.methods.items():
+            for lock, held, lineno in meth.acquires:
+                for h in uentry[mname] | held:
+                    if h != lock and (h, lock) not in edges:
+                        edges[(h, lock)] = (mname, lineno)
+
+        adj: dict[str, set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+
+        # Tarjan SCC: any component with >1 lock contains an ordering
+        # cycle.
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on_stack: set[str] = set()
+        stack: list[str] = []
+        sccs: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in sorted(adj.get(v, ())):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+
+        for comp in sccs:
+            if len(comp) < 2:
+                continue
+            cset = set(comp)
+            sites = sorted(
+                f"{a}->{b} ({m}:{ln})"
+                for (a, b), (m, ln) in edges.items()
+                if a in cset and b in cset
+            )
+            first_line = min(
+                ln for (a, b), (_m, ln) in edges.items()
+                if a in cset and b in cset
+            )
+            cyc = "<".join(sorted(cset))
+            self.rep.add(
+                self.ctx, first_line, "RT205",
+                f"{self.cls.name}: lock-acquisition order cycle "
+                f"between {sorted(cset)} — opposite-order paths can "
+                f"deadlock: {'; '.join(sites)}",
+                key=f"RT205:{self.ctx.rel}:{self.cls.name}:{cyc}")
 
 
 def check(ctx: FileCtx, rep: Reporter) -> None:

@@ -7,8 +7,15 @@ tools/analyze/baseline.json).  Rule catalog and conventions:
 docs/static-analysis.md.  `python tools/lint.py --list-rules` prints
 the family summary.
 
-Usage: python tools/lint.py [paths...] [--update-baseline]
+Usage: python tools/lint.py [paths...] [--update-baseline] [--device]
 Exit code 1 if any non-baselined finding.
+
+`--device` additionally runs the RT300 device-program pass: imports
+jax (CPU backend), AOT-lowers every `@device_entry`-registered program
+on a tiny synthetic mesh and checks merge algebra, counter-overflow
+intervals, donation coverage, replication and host/device predicate
+parity (seconds, not milliseconds — hence opt-in; the default lint
+stays pure-AST and fast).  `make analyze-device` is the same thing.
 """
 
 from __future__ import annotations
