@@ -625,6 +625,17 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
                     out[s.labels.get("stage", "")] = s.value
         return out
 
+    def _feed_dropped() -> int:
+        # Blocks the feed path dropped (staging saturated or handoff to
+        # a dead consumer) — a per-window delta > 0 marks a window whose
+        # missing events never reached the device at all.
+        pool = eng._feed_pool
+        if pool is None:
+            return 0
+        return pool.staging_dropped_blocks + sum(
+            w.handoff_dropped for w in pool.workers
+        )
+
     def measure_window() -> dict:
         ev0 = eng._events_in
         bytes0 = m.transfer_bytes._value.get()
@@ -632,6 +643,8 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         samp0 = m.events_sampled._value.get()
         shed0 = _shed_counts()
         xf0 = m.transfer_seconds._sum.get()
+        defer0 = m.windows_deferred._value.get()
+        drop0 = _feed_dropped()
         t0 = time.monotonic()
         lat: list[float] = []
         while time.monotonic() - t0 < dur:
@@ -658,6 +671,14 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
             "transfer_share": (
                 (m.transfer_seconds._sum.get() - xf0) / elapsed
             ),
+            # How many window closes the protected close lane deferred
+            # (both slots in flight behind a stalled link) and how many
+            # blocks the feed path dropped during THIS window — the two
+            # attribution signals the r05 0.00M windows were missing.
+            "windows_deferred": int(
+                m.windows_deferred._value.get() - defer0
+            ),
+            "feed_dropped": _feed_dropped() - drop0,
             # Per-window overload diagnostics: what the adaptive
             # controller did to KEEP this window's event count nonzero
             # (docs/operations.md §6). events_sampled is the
@@ -711,8 +732,11 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         """Attribute one stalled (sub-floor) window to its most likely
         cause, in evidence order: bucket-grid warm still compiling in
         the background > overload controller actively degrading >
-        transfer RPCs owning the window's wall clock > an outright
-        harness-transport outage (the proxy parked, nothing moved)."""
+        transfer RPCs owning the window's wall clock > window closes
+        deferring on the protected close lane (the link wedged with
+        both close slots in flight) > the feed path dropping blocks
+        (staging saturated) > an outright harness-transport outage
+        (the proxy parked, nothing moved, nothing dropped)."""
         if w["rate"] >= STALL_FLOOR:
             return None
         if not w["warm_done"]:
@@ -721,6 +745,10 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
             return f"overload:{w['overload_state']}"
         if w["transfer_share"] >= 0.5:
             return "transfer_stall"
+        if w.get("windows_deferred", 0) > 0:
+            return "close_backlog"
+        if w.get("feed_dropped", 0) > 0:
+            return "staging_saturated"
         return "transport_outage"
 
     while len(windows) < 7 and any(
@@ -878,7 +906,8 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         # classification comment above); the headline median runs over
         # the non-stalled windows only. Every stalled window carries an
         # attributed cause (warm / overload:<state> / transfer_stall /
-        # transport_outage) — never silently re-measured.
+        # close_backlog / staging_saturated / transport_outage) —
+        # never silently re-measured.
         "stalled_windows": n_stalled,
         "stall_causes": [c for c in map(_stall_cause, windows) if c],
         # Median over the non-stalled windows only (the STALL_FLOOR
@@ -1112,6 +1141,17 @@ def main() -> None:
                     ),
                     "extra": {"e2e": e2e, "device_step": device},
                 }
+                # Stall gate (default run only): the acceptance target
+                # is an UNFILTERED median with zero stall windows — a
+                # run that needed the stall filter to look healthy must
+                # fail loudly, with every window's attributed cause in
+                # the error line, not pass on the filtered number.
+                n_st = e2e.get("stalled_windows", 0)
+                if n_st:
+                    out["error"] = (
+                        f"stall gate: {n_st} stalled window(s), "
+                        f"causes={e2e.get('stall_causes', [])}"
+                    )
             except Exception as e:  # noqa: BLE001
                 log("e2e phase FAILED:\n" + traceback.format_exc())
                 out = device  # device-step headline as the fallback

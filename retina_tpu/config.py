@@ -153,7 +153,15 @@ class Config:
     # Per-worker staging bound, in raw sink blocks. A block that finds
     # every worker's staging full is dropped + counted (lost_events
     # stage="handoff") — backpressure never blocks the distributor.
-    feed_staging_blocks: int = 256
+    # Sized so one worker can stage a FULL flush quantum even from
+    # small sink blocks (quantum / typical-block-rows with headroom):
+    # at 256 the staging ring capped quanta at ~18% fill under
+    # sustained load (BENCH_r05 staging fill 0.184) — flushes were
+    # capacity-cut, not age-cut, and every fixed per-flush cost was
+    # paid 5x too often. Memory bound: blocks are staged by reference
+    # (the sink's arrays, no copy), so the bound is backlog, not
+    # allocation.
+    feed_staging_blocks: int = 1024
     # Background bucket-grid warm proxy duty cycle: after each warmed
     # key the warm thread yields cost*(1-d)/d seconds (capped at 10s)
     # to live traffic. 0.5 = equal yield (~50% proxy share, the
@@ -182,6 +190,14 @@ class Config:
     # Steady-state wire bytes/event drop ~6x on long-lived flows.
     # Requires transfer_packed.
     wire_flow_dict: bool = True
+    # v4 wire: pack known-flow rows as a DENSE bitstream —
+    # (id_bits + 10 + 22) contiguous bits per row (parallel/wire.py
+    # dense layer) instead of two full u32 lanes: 6.25 B/row at the
+    # default 18-bit id space vs 8. Rows whose PACKETS/BYTES overflow
+    # the narrow lanes escalate to the full-row side (same contract as
+    # the v3 packet-overflow escalation). Off = v3 two-lane rows, for
+    # debugging/bisection only.
+    wire_dense_known: bool = True
     # Device descriptor-table slots (48 B/slot/device). Must exceed the
     # live distinct-descriptor count or the dictionary cycles
     # (generation clear -> one re-upload burst).

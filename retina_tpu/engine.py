@@ -191,7 +191,25 @@ class SketchEngine:
         # (see _dispatch_flowdict).
         self._fd_id_bits = max(1, (cfg.flow_dict_slots - 1).bit_length())
         self._fd_pk_bits = 32 - self._fd_id_bits
+        # v4 wire: known rows pack DENSE — (id_bits + 10 + 22)
+        # contiguous bits per row streamed into one u32 word array
+        # (parallel/wire.py dense layer) instead of two full u32 lanes:
+        # 6.25 B/row at the default 18-bit id space vs 8. Rows whose
+        # PACKETS/BYTES overflow the narrow lanes escalate to the
+        # full-row side exactly like the v3 packet-overflow escalation.
+        self._fd_dense = bool(cfg.wire_dense_known)
         self._fd_lock = threading.Lock()
+        # AOT disk-cache signature for the per-bucket ingest
+        # executables (_compile_cached): every config field that
+        # changes their lowered programs. The topology/jax-version part
+        # of the key lives in telemetry.aot_disk_path.
+        self._aot_sig = "|".join(
+            str(x) for x in (
+                cfg.batch_capacity, cfg.flow_dict_slots,
+                int(bool(cfg.transfer_packed)), self._fd_id_bits,
+                int(self._fd_dense), NUM_FIELDS,
+            )
+        )
         # heavy_keys_source="both": host-side per-key packet ground
         # truth (forward-verdict packets by 4-column flow key), fed in
         # _dispatch_flowdict under _fd_lock; the harvest thread scores
@@ -929,6 +947,35 @@ class SketchEngine:
         self._dispatch_sharded(sb, now_s, n_raw=len(records),
                                record_metrics=record_metrics)
 
+    def _compile_cached(self, tag: str, key, lower):  # runs-on: device-proxy
+        """Compile one per-bucket ingest executable, consulting the AOT
+        disk cache first. ``lower`` is a thunk returning the
+        ``jax.stages.Lowered``; on a miss its compiled executable is
+        persisted via ``serialize_executable`` keyed by (jax version,
+        topology, engine config signature, tag, bucket key) — a
+        restarted daemon then warms the whole bucket grid by
+        deserializing instead of re-lowering every key, which is what
+        turns the 214s r05 bucket warm into a <10s disk load. Same
+        format, path scheme, and hit/miss counters as the telemetry
+        step programs (telemetry.aot_disk_*)."""
+        from retina_tpu.parallel.telemetry import (
+            aot_disk_load, aot_disk_path, aot_disk_save,
+        )
+
+        path = None
+        if self.cfg.aot_cache_dir:
+            path = aot_disk_path(
+                self.cfg.aot_cache_dir, self.mesh, tag,
+                self._aot_sig, key,
+            )
+            ex = aot_disk_load(path)
+            if ex is not None:
+                return ex
+        ex = lower().compile()
+        if path is not None:
+            aot_disk_save(path, ex)
+        return ex
+
     @device_entry("engine.ingest", kind="jit")
     def _ingest_fn(self, bucket: int, packed: bool):  # runs-on: device-proxy
         """Per-bucket jit that turns ONE transferred (D, bucket, P) wire
@@ -996,7 +1043,7 @@ class SketchEngine:
             # XLA cache across restarts), never a mid-feed trace+infer
             # surprise on the proxy thread.
             width = PACKED_FIELDS if packed else NUM_FIELDS
-            fn = ingest.lower(
+            fn = self._compile_cached("ingest", key, lambda: ingest.lower(
                 jax.ShapeDtypeStruct(
                     (self.n_devices, bucket, width), jnp.uint32,
                     sharding=self._rec_sharding,
@@ -1005,7 +1052,7 @@ class SketchEngine:
                     (5 + self.n_devices,), jnp.uint32,
                     sharding=self._replicated,
                 ),
-            ).compile()
+            ))
             self._pad_cache[key] = fn
         return fn
 
@@ -1123,7 +1170,7 @@ class SketchEngine:
                 )
                 return wins, nvs, meta[2], meta[3], table
 
-            fn = ingest.lower(
+            fn = self._compile_cached("ingest_new", key, lambda: ingest.lower(
                 jax.ShapeDtypeStruct(
                     (self.n_devices, bucket, PACKED_FIELDS + 1),
                     jnp.uint32, sharding=self._rec_sharding,
@@ -1139,19 +1186,26 @@ class SketchEngine:
                     ),
                     jnp.uint32, sharding=self._rec_sharding,
                 ),
-            ).compile()
+            ))
             self._pad_cache[key] = fn
         return fn
 
     @device_entry("engine.ingest_known", kind="jit")
     def _ingest_known_fn(self, bucket: int):  # runs-on: device-proxy
-        """Per-bucket jit for KNOWN flows: (D, bucket, 2) wire of
-        [table_id | packets << id_bits, bytes] + meta + descriptor
-        table -> gather the resident 12-lane descriptors from HBM,
-        overlay the per-quantum counters, unpack, slice into step
-        windows. meta[4] is the biased TS_REL flag for every known row
-        (1 = stamped at the flush base meta[0:2], 0 = unstamped flush).
-        8 bytes per flow row on the link instead of 48 (v2 was 16).
+        """Per-bucket jit for KNOWN flows: counter wire + meta +
+        descriptor table -> gather the resident 12-lane descriptors
+        from HBM, overlay the per-quantum counters, unpack, slice into
+        step windows. meta[4] is the biased TS_REL flag for every known
+        row (1 = stamped at the flush base meta[0:2], 0 = unstamped
+        flush).
+
+        Wire layout depends on ``_fd_dense`` (wire_dense_known):
+          v3 (dense off): (D, bucket, 2) of [id | packets << id_bits,
+              bytes] — 8 B/row instead of the 48 B full row.
+          v4 (dense on, default): (D, W) bitstream of
+              (id_bits + 10 + 22)-bit rows (parallel/wire.py dense
+              layer) — 6.25 B/row at the default 18-bit id space; the
+              device side unpacks with two-word gathers.
 
         Reference analog: the kernel map hit path — established flows
         move counters only (conntrack.c ct_process_packet accumulate).
@@ -1164,7 +1218,8 @@ class SketchEngine:
             from functools import partial as _partial
 
             from retina_tpu.parallel.wire import (
-                PACKED_FIELDS, unpack_records_device,
+                PACKED_FIELDS, dense_known_unpack_device, dense_words,
+                unpack_records_device,
             )
 
             # HOST scalars (np, not jnp), deliberately: a jnp scalar
@@ -1178,6 +1233,7 @@ class SketchEngine:
             # device traffic.
             id_bits = np.uint32(self._fd_id_bits)
             id_mask = np.uint32((1 << self._fd_id_bits) - 1)
+            dense = self._fd_dense
             out_sh = (
                 (self._rec_sharding,) * n_win,
                 (self._rec_sharding,) * n_win,
@@ -1185,18 +1241,24 @@ class SketchEngine:
                 self._replicated,
             )
 
-            # donate_argnums=(0,): the (D, bucket, 2) counter wire is
-            # single-use per flush (RT302). The descriptor table (2)
-            # must NOT be donated: it is RESIDENT — the same buffer is
-            # read by every subsequent known-flow flush.
+            # donate_argnums=(0,): the counter wire is single-use per
+            # flush (RT302). The descriptor table (2) must NOT be
+            # donated: it is RESIDENT — the same buffer is read by
+            # every subsequent known-flow flush.
             @_partial(jax.jit, out_shardings=out_sh, donate_argnums=(0,))
             def ingest(wire, meta, table):
-                ids = wire[..., 0] & id_mask
-                pk = wire[..., 0] >> id_bits
-                d_idx = jnp.arange(wire.shape[0])[:, None]
+                if dense:
+                    ids, pk, by = dense_known_unpack_device(
+                        wire, bucket, self._fd_id_bits
+                    )
+                else:
+                    ids = wire[..., 0] & id_mask
+                    pk = wire[..., 0] >> id_bits
+                    by = wire[..., 1]
+                d_idx = jnp.arange(ids.shape[0])[:, None]
                 desc = table[d_idx, ids]  # (D, bucket, 12)
                 desc = desc.at[..., 6].set(pk)  # PACKETS
-                desc = desc.at[..., 5].set(wire[..., 1])  # BYTES
+                desc = desc.at[..., 5].set(by)  # BYTES
                 desc = desc.at[..., 0].set(
                     jnp.broadcast_to(meta[4], ids.shape)  # TS_REL
                 )
@@ -1207,9 +1269,13 @@ class SketchEngine:
                 )
                 return wins, nvs, meta[2], meta[3]
 
-            fn = ingest.lower(
+            wire_shape = (
+                (self.n_devices, dense_words(bucket, self._fd_id_bits))
+                if dense else (self.n_devices, bucket, 2)
+            )
+            fn = self._compile_cached("ingest_known", key, lambda: ingest.lower(
                 jax.ShapeDtypeStruct(
-                    (self.n_devices, bucket, 2), jnp.uint32,
+                    wire_shape, jnp.uint32,
                     sharding=self._rec_sharding,
                 ),
                 jax.ShapeDtypeStruct(
@@ -1223,7 +1289,7 @@ class SketchEngine:
                     ),
                     jnp.uint32, sharding=self._rec_sharding,
                 ),
-            ).compile()
+            ))
             self._pad_cache[key] = fn
         return fn
 
@@ -1274,7 +1340,8 @@ class SketchEngine:
         (idempotent re-scatter). Both ride one proxy submission,
         FIFO-ordered so inserts land before gathers."""
         from retina_tpu.parallel.wire import (
-            batch_ts_base, known_rows, pack_records,
+            DENSE_BY_BITS, DENSE_PK_BITS, batch_ts_base,
+            dense_known_rows, dense_words, known_rows, pack_records,
         )
 
         t_d0 = time.monotonic()
@@ -1297,17 +1364,22 @@ class SketchEngine:
             fd_entries = len(self._flow_dict)
             fd_generation = self._flow_dict.generation
         base = batch_ts_base(sb.records)
-        pk_cap = np.uint32(1) << np.uint32(self._fd_pk_bits)
+        dense = self._fd_dense
+        pk_cap = np.uint32(1) << np.uint32(
+            DENSE_PK_BITS if dense else self._fd_pk_bits
+        )
         id_bits = np.uint32(self._fd_id_bits)
         # Escalate to the full-row side (exact per-row fields) any known
-        # row the 8-byte lanes cannot represent faithfully: packet
-        # counts over the id lane's headroom, rows carrying TSval/TSecr
-        # (the RTT matcher needs their EXACT send time — the flush-base
-        # stamp below would record phantom times), and unstamped rows
-        # (TS_REL=0 must round-trip to ts 0, wire.py:17-23). The masks
-        # are computed once and reused for sizing + build. All in-tree
-        # sources stamp and TSval rows are apiserver-RTT traffic only,
-        # so escalation stays rare.
+        # row the narrow lanes cannot represent faithfully: packet
+        # counts over the packets lane's headroom, rows carrying
+        # TSval/TSecr (the RTT matcher needs their EXACT send time —
+        # the flush-base stamp below would record phantom times), and
+        # unstamped rows (TS_REL=0 must round-trip to ts 0,
+        # wire.py:17-23). The dense wire additionally escalates rows
+        # whose BYTES overflow the 22-bit lane (v3 ships bytes as a
+        # full u32). The masks are computed once and reused for
+        # sizing + build. All in-tree sources stamp and TSval rows are
+        # apiserver-RTT traffic only, so escalation stays rare.
         sel_new = [
             x[2]
             | (x[0][:, F.PACKETS] >= pk_cap)
@@ -1315,15 +1387,22 @@ class SketchEngine:
             | ((x[0][:, F.TS_LO] | x[0][:, F.TS_HI]) == 0)
             for x in per_dev
         ]
+        if dense:
+            by_cap = np.uint32(1) << np.uint32(DENSE_BY_BITS)
+            for s, x in zip(sel_new, per_dev):
+                s |= x[0][:, F.BYTES] >= by_cap
         n_new = [int(s.sum()) for s in sel_new]
         n_known = [len(x[0]) - nn for x, nn in zip(per_dev, n_new)]
         Bn = self._wire_bucket(max(n_new) if n_new else 0)
         Bk = self._wire_bucket(max(n_known) if n_known else 0)
         new_wire = np.zeros((D, Bn, 13), np.uint32)
-        known_wire = np.zeros((D, Bk, 2), np.uint32)
+        known_wire = np.zeros(
+            (D, dense_words(Bk, int(id_bits))) if dense else (D, Bk, 2),
+            np.uint32,
+        )
         nv_new = np.zeros((D,), np.uint32)
         nv_known = np.zeros((D,), np.uint32)
-        from retina_tpu.native import flowwire_native
+        from retina_tpu.native import flowwire_dense_native, flowwire_native
 
         for d, (rows, ids, _) in enumerate(per_dev):
             sel = sel_new[d]
@@ -1344,12 +1423,21 @@ class SketchEngine:
                 # One native pass builds both sides in place — the
                 # numpy path below pays two fancy-indexed row copies +
                 # a pack pass + two bit-pack passes per device.
-                got = flowwire_native(
-                    np.ascontiguousarray(rows), ids,
-                    sel.astype(np.uint8), int(base),
-                    int(self._fd_id_bits),
-                    new_wire[d], known_wire[d],
-                )
+                if dense:
+                    got = flowwire_dense_native(
+                        np.ascontiguousarray(rows), ids,
+                        sel.astype(np.uint8), int(base),
+                        int(self._fd_id_bits),
+                        DENSE_PK_BITS, DENSE_BY_BITS,
+                        new_wire[d], known_wire[d],
+                    )
+                else:
+                    got = flowwire_native(
+                        np.ascontiguousarray(rows), ids,
+                        sel.astype(np.uint8), int(base),
+                        int(self._fd_id_bits),
+                        new_wire[d], known_wire[d],
+                    )
             if got is not None:
                 assert got == nn, (got, nn)
             elif len(rows):
@@ -1360,9 +1448,14 @@ class SketchEngine:
                     new_wire[d, : len(rn), 0] = idn
                     new_wire[d, : len(rn), 1:] = packed12
                 if len(rk):
-                    known_rows(
-                        rk, idk, id_bits, known_wire[d, : len(rk)]
-                    )
+                    if dense:
+                        dense_known_rows(
+                            rk, idk, int(id_bits), known_wire[d]
+                        )
+                    else:
+                        known_rows(
+                            rk, idk, id_bits, known_wire[d, : len(rk)]
+                        )
             nv_new[d] = nn
             nv_known[d] = nk
         if record_metrics and lost:
@@ -2338,16 +2431,17 @@ class SketchEngine:
             elif item[0] == "step":
                 self._dispatch_sharded(item[1], item[2], item[3])
             else:
-                try:
-                    # _close_window self-proxies: the close (and the
-                    # harvest's device_get) never runs concurrently
-                    # with proxied step dispatches.
-                    self._close_window()
-                except Exception as e:
-                    if self._count_error("window_close"):
-                        self.log.exception("window close failed")
-                    if self._fatal_device_error(e):
-                        self._request_recovery(repr(e))
+                # Fire-and-forget close on the protected lane, same as
+                # pipeline mode: the proxy FIFO still orders it after
+                # every step submitted before the tick, but the feed
+                # loop no longer waits out the device round-trip — a
+                # blocking close here serialized the feed for the full
+                # end_window dispatch and was the single biggest
+                # stall-window source in depth==0 runs (BENCH_r05
+                # 0.00M windows). Errors are handled inside the
+                # submission (safe_close), including fatal-device
+                # recovery.
+                self._submit_close_window()
 
         if depth > 0:
             if n_workers > 1:
@@ -2487,7 +2581,18 @@ class SketchEngine:
                         flush()
                 if now >= next_window:
                     submit(("window", None, 0, 0))
-                    next_window = now + self.cfg.window_seconds
+                    # Batched tick: one close per catch-up, however many
+                    # boundaries a stall skipped. Advancing by the missed
+                    # count keeps the cadence phase-locked to the start
+                    # time (ticks do not drift later under load) without
+                    # queueing a burst of back-to-back closes on the ctl
+                    # lane after the stall clears.
+                    n_missed = int(
+                        (now - next_window) // self.cfg.window_seconds
+                    )
+                    next_window += (
+                        (n_missed + 1) * self.cfg.window_seconds
+                    )
                 if not blocks:
                     stop.wait(0.002)
         finally:

@@ -35,13 +35,20 @@ _lib: Optional[ctypes.CDLL] = None
 _lock = threading.Lock()
 _build_failed = False
 
+# Expected ABI of libretina_native.so (ring.cpp rt_abi_version — the
+# single source of truth on the C++ side). The loader refuses a library
+# reporting anything else: a stale prebuilt .so (wrong checkout, wrong
+# arch cache) would otherwise misparse the dense wire bitstream or the
+# striped-combine arguments silently. Bump BOTH sides together.
+NATIVE_ABI_VERSION = 2
 
-def _build() -> bool:
+
+def _build(force: bool = False) -> bool:
     try:
-        subprocess.run(
-            ["make", "-C", _dir, "-s"],
-            check=True, capture_output=True, timeout=120,
-        )
+        cmd = ["make", "-C", _dir, "-s"]
+        if force:
+            cmd.append("-B")
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
             FileNotFoundError) as e:
@@ -49,6 +56,18 @@ def _build() -> bool:
         _log.warning("native build failed (%s); using Python fallbacks: %s",
                      e, detail.decode(errors="replace")[:500])
         return False
+
+
+def _loaded_abi(lib: ctypes.CDLL) -> int:
+    """ABI version a loaded library reports (0 = pre-versioning v1-era
+    binary with no rt_abi_version export)."""
+    try:
+        fn = lib.rt_abi_version
+    except AttributeError:
+        return 0
+    fn.restype = ctypes.c_uint32
+    fn.argtypes = []
+    return int(fn())
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -75,6 +94,28 @@ def get_lib() -> Optional[ctypes.CDLL]:
             _log.warning("native library load failed: %s", e)
             _build_failed = True
             return None
+        # ABI gate: an .so that predates (or postdates) this checkout's
+        # bindings gets one forced rebuild from source; if the toolchain
+        # can't produce a matching binary, fall back to Python rather
+        # than call through a mismatched ABI.
+        abi = _loaded_abi(lib)
+        if abi != NATIVE_ABI_VERSION:
+            _log.warning(
+                "native library ABI %d != expected %d; rebuilding",
+                abi, NATIVE_ABI_VERSION,
+            )
+            if not _build(force=True):
+                _build_failed = True
+                return None
+            lib = ctypes.CDLL(_so_path)
+            abi = _loaded_abi(lib)
+            if abi != NATIVE_ABI_VERSION:
+                _log.warning(
+                    "native library ABI still %d after rebuild; "
+                    "using Python fallbacks", abi,
+                )
+                _build_failed = True
+                return None
         lib.rt_decode_pcap.restype = ctypes.c_long
         lib.rt_decode_pcap.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32,
@@ -102,6 +143,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32)),
             ctypes.POINTER(ctypes.c_size_t), ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+        ]
+        lib.rt_combine_stripe.restype = ctypes.c_long
+        lib.rt_combine_stripe.argtypes = [
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32)),
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            ctypes.c_uint32, ctypes.c_uint32,
         ]
         lib.rt_flowdict_new.restype = ctypes.c_void_p
         lib.rt_flowdict_new.argtypes = [ctypes.c_uint32]
@@ -134,6 +182,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint32),
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
             ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.rt_flowwire_dense.restype = ctypes.c_long
+        lib.rt_flowwire_dense.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
             ctypes.POINTER(ctypes.c_uint32),
         ]
         lib.rt_afp_open.restype = ctypes.c_void_p
@@ -312,6 +369,89 @@ def combine_native_blocks(
     return out[:g]
 
 
+def native_abi_version() -> Optional[int]:
+    """ABI version of the loaded native library (None when unavailable).
+    get_lib() already enforces == NATIVE_ABI_VERSION; this exists for
+    the tier-1 ABI check and diagnostics."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    return _loaded_abi(lib)
+
+
+def combine_native_blocks_striped(
+    blocks: list, n_stripes: int,
+) -> Optional[np.ndarray]:
+    """Multi-consumer combine crew (combine.cpp rt_combine_stripe): T
+    Python threads each combine ONE key-hash stripe of the same block
+    list into a private output buffer — the ctypes calls release the
+    GIL, the key partition makes the flow sets disjoint, so there is no
+    merge pass and no shared mutable state (per-worker partitioned
+    combine). Output concatenates the stripes; row order therefore
+    differs from the single-pass combine (consumers treat order as
+    arbitrary), but the key -> (packets, bytes, latest-ts) map is
+    identical — cross-checked by tests/test_combine_scaling.py.
+    Returns None when the library is unavailable or any block isn't a
+    plain (N, 16) u32 array — callers fall back."""
+    global _combine_hint_groups
+    lib = get_lib()
+    if lib is None or not blocks or n_stripes < 2:
+        return None
+    total = 0
+    for b in blocks:
+        if (b.ndim != 2 or b.shape[1] != 16 or b.dtype != np.uint32
+                or not b.flags.c_contiguous):
+            return None
+        total += len(b)
+    if total == 0:
+        return blocks[0][:0]
+    n_stripes = min(int(n_stripes), 16)
+    ptrs = (ctypes.POINTER(ctypes.c_uint32) * len(blocks))(
+        *[b.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+          for b in blocks]
+    )
+    ns = (ctypes.c_size_t * len(blocks))(*[len(b) for b in blocks])
+    # Per-stripe buffers sized for the worst case (all rows one stripe):
+    # np.empty is a virtual allocation, so untouched pages of the slack
+    # cost address space, not RAM.
+    outs = [np.empty((total, 16), np.uint32) for _ in range(n_stripes)]
+    counts = [0] * n_stripes
+    hint = (4 * _combine_hint_groups) // n_stripes
+
+    def run(s: int) -> None:
+        counts[s] = lib.rt_combine_stripe(
+            ptrs, ns, len(blocks),
+            outs[s].ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            hint, s, n_stripes,
+        )
+
+    workers = [
+        threading.Thread(target=run, args=(s,), daemon=True)
+        for s in range(1, n_stripes)
+    ]
+    try:
+        for w in workers:
+            w.start()
+    except RuntimeError:  # noqa: RT101 — not swallowed: a stripe whose
+        # thread never spawned (pid pressure) is detected below by
+        # w.ident is None and re-run sequentially on this thread, so
+        # the result is identical either way; nothing to count.
+        pass
+    run(0)
+    for w in workers:
+        if w.ident is not None:
+            w.join()
+        else:
+            run(workers.index(w) + 1)
+    if any(c < 0 for c in counts):
+        return None
+    g = sum(int(c) for c in counts)
+    _combine_hint_groups = g
+    return np.concatenate(
+        [outs[s][: int(counts[s])] for s in range(n_stripes)], axis=0
+    )
+
+
 def flowwire_native(
     rows: np.ndarray, ids: np.ndarray, sel_new: np.ndarray,
     base: int, id_bits: int, new_out: np.ndarray,
@@ -349,6 +489,51 @@ def flowwire_native(
         sel_new.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         ctypes.c_uint64(int(base)), ctypes.c_uint32(int(id_bits)),
         new_out.ctypes.data_as(u32p), known_out.ctypes.data_as(u32p),
+    ))
+
+
+def flowwire_dense_native(
+    rows: np.ndarray, ids: np.ndarray, sel_new: np.ndarray,
+    base: int, id_bits: int, pk_bits: int, by_bits: int,
+    new_out: np.ndarray, known_words: np.ndarray,
+) -> Optional[int]:
+    """C++ v4 dense flow-dict wire build (pack.cpp rt_flowwire_dense):
+    like flowwire_native but known rows land in the ZEROED 1-D
+    ``known_words`` bitstream at (id_bits + pk_bits + by_bits) bits per
+    row (parallel/wire.py dense_known_rows is the numpy twin). Returns
+    the new-row count, or None when the library is unavailable / the
+    inputs don't match the fast-path layout."""
+    lib = get_lib()
+    n = len(rows)
+    row_bits = int(id_bits) + int(pk_bits) + int(by_bits)
+    if (lib is None or row_bits > 64
+            or rows.ndim != 2 or rows.shape[1] != NUM_FIELDS
+            or rows.dtype != np.uint32 or not rows.flags.c_contiguous
+            or ids.dtype != np.uint32 or not ids.flags.c_contiguous
+            or sel_new.dtype != np.uint8
+            or not sel_new.flags.c_contiguous
+            or len(ids) != n or len(sel_new) != n
+            or new_out.dtype != np.uint32
+            or known_words.dtype != np.uint32
+            or not new_out.flags.c_contiguous
+            or not known_words.flags.c_contiguous
+            or new_out.ndim != 2 or new_out.shape[1] != 13
+            or known_words.ndim != 1):
+        return None
+    # Capacity guard: n_new*13 words on the new side, the dense stream
+    # plus one pad word on the known side — undersized must fall back,
+    # not corrupt.
+    n_sel = int(sel_new.sum())
+    need = ((n - n_sel) * row_bits + 31) // 32 + 1
+    if len(new_out) < n_sel or len(known_words) < need:
+        return None
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    return int(lib.rt_flowwire_dense(
+        rows.ctypes.data_as(u32p), n, ids.ctypes.data_as(u32p),
+        sel_new.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_uint64(int(base)), ctypes.c_uint32(int(id_bits)),
+        ctypes.c_uint32(int(pk_bits)), ctypes.c_uint32(int(by_bits)),
+        new_out.ctypes.data_as(u32p), known_words.ctypes.data_as(u32p),
     ))
 
 

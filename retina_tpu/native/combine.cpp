@@ -57,6 +57,14 @@ inline uint32_t sat_add_u32(uint32_t a, uint32_t b) {
   return s > 0xFFFFFFFFull ? 0xFFFFFFFFu : (uint32_t)s;
 }
 
+// Which of n_stripes key-partitions a row belongs to. Uses the UPPER
+// hash bits (the table index burns the lower ones — reusing them would
+// collapse each stripe's slot distribution) via a multiply-shift range
+// map, so any n_stripes works without a per-row divide.
+inline uint32_t stripe_of(uint64_t h, uint32_t n_stripes) {
+  return (uint32_t)(((uint64_t)(uint32_t)(h >> 32) * n_stripes) >> 32);
+}
+
 }  // namespace
 
 extern "C" {
@@ -170,15 +178,17 @@ long rt_combine(const uint32_t* rows, size_t n, uint32_t* out) {
   return rt_combine_hint(rows, n, out, 0);
 }
 
-// Multi-block combine: same single-pass table as rt_combine_hint but
-// consuming a LIST of row blocks — the feed loop's flush quantum is a
-// list of sink blocks, and concatenating them first costs a full
-// row-copy pass (~40% of the combine stage at production quanta).
-// First-appearance output order matches exactly what rt_combine_hint
-// would produce on the concatenation, so results are bit-identical
-// (cross-checked by the test suite).
-long rt_combine_multi(const uint32_t* const* blocks, const size_t* ns,
-                      size_t nblocks, uint32_t* out, size_t hint_slots) {
+// The one table body behind every combine entry point (single-block,
+// multi-block, striped) — a fix can never diverge between them.
+// stripe/n_stripes: with n_stripes > 1, only rows whose key hashes into
+// the given stripe (stripe_of) are combined; the rest are skipped. Key
+// partitioning makes concurrent striped calls over the SAME blocks
+// write disjoint flow sets — the multi-consumer combine crew needs no
+// cross-worker merge pass and no locks (each worker owns its out
+// buffer; the input blocks are read-only).
+static long combine_core(const uint32_t* const* blocks, const size_t* ns,
+                         size_t nblocks, uint32_t* out, size_t hint_slots,
+                         uint32_t stripe, uint32_t n_stripes) {
   size_t n = 0;
   for (size_t b = 0; b < nblocks; b++) n += ns[b];
   if (n == 0) return 0;
@@ -208,12 +218,14 @@ long rt_combine_multi(const uint32_t* const* blocks, const size_t* ns,
     }
     for (size_t i = 0; i < nb; i++) {
       const uint32_t* row = rows + i * NUM_FIELDS;
-      size_t slot = next_hashes[i % kAhead] & mask;
+      size_t h_i = next_hashes[i % kAhead];
+      size_t slot = h_i & mask;
       if (i + kAhead < nb) {
         size_t h = hash_row(rows + (i + kAhead) * NUM_FIELDS);
         next_hashes[(i + kAhead) % kAhead] = h;
         __builtin_prefetch(&table[h & mask]);
       }
+      if (n_stripes > 1 && stripe_of(h_i, n_stripes) != stripe) continue;
       if (2 * g >= slots && slots < worst) {
         size_t nslots = slots << 1;
         uint32_t* ntable = (uint32_t*)malloc(nslots * sizeof(uint32_t));
@@ -262,6 +274,38 @@ long rt_combine_multi(const uint32_t* const* blocks, const size_t* ns,
   }
   free(table);
   return (long)g;
+}
+
+// Multi-block combine: same single-pass table as rt_combine_hint but
+// consuming a LIST of row blocks — the feed loop's flush quantum is a
+// list of sink blocks, and concatenating them first costs a full
+// row-copy pass (~40% of the combine stage at production quanta).
+// First-appearance output order matches exactly what rt_combine_hint
+// would produce on the concatenation, so results are bit-identical
+// (cross-checked by the test suite).
+long rt_combine_multi(const uint32_t* const* blocks, const size_t* ns,
+                      size_t nblocks, uint32_t* out, size_t hint_slots) {
+  return combine_core(blocks, ns, nblocks, out, hint_slots, 0, 1);
+}
+
+// Striped multi-consumer combine: combine ONLY the rows of one key
+// partition (stripe of n_stripes, see stripe_of). T concurrent callers
+// over the same blocks with stripes 0..T-1 produce disjoint flow sets
+// whose concatenation equals rt_combine_multi's output as a key->value
+// map (first-appearance order is per-stripe). This is the per-worker
+// partitioned combine of the feed pool's combine crew: unlike
+// rt_combine_mt's chunk+sequential-merge, there is NO merge pass and no
+// shared mutable state — each worker scans all rows but hashes/probes
+// only its own stripe's, so the expensive part (table writes, output
+// row copies) parallelizes perfectly.
+long rt_combine_stripe(const uint32_t* const* blocks, const size_t* ns,
+                       size_t nblocks, uint32_t* out, size_t hint_slots,
+                       uint32_t stripe, uint32_t n_stripes) {
+  if (n_stripes <= 1)
+    return combine_core(blocks, ns, nblocks, out, hint_slots, 0, 1);
+  if (stripe >= n_stripes) return 0;
+  return combine_core(blocks, ns, nblocks, out, hint_slots, stripe,
+                      n_stripes);
 }
 
 }  // extern "C"

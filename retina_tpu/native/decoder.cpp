@@ -214,6 +214,14 @@ long rt_decode_pcap(const uint8_t* data, size_t len, uint32_t obs_point,
   return static_cast<long>(n);
 }
 
-uint32_t rt_abi_version(void) { return 1; }
+// ABI version of libretina_native.so. Bump on ANY exported-signature or
+// wire-layout change; the Python loader (native/__init__.py
+// NATIVE_ABI_VERSION) refuses a mismatched binary and rebuilds from
+// source, so a stale .so from another checkout can never silently
+// misparse the wire.
+//   v1: rt_combine/rt_combine_mt/rt_flowwire era
+//   v2: + rt_combine_stripe (striped multi-consumer combine) and
+//       rt_flowwire_dense (v4 dense known-row bitstream)
+uint32_t rt_abi_version(void) { return 2; }
 
 }  // extern "C"

@@ -110,4 +110,55 @@ long rt_flowwire(const uint32_t* rows, size_t n, const uint32_t* ids,
   return (long)n_new;
 }
 
+// v4 dense flow-dict wire build: like rt_flowwire, but known rows go
+// into a CONTIGUOUS BITSTREAM of (id_bits + pk_bits + by_bits)-bit
+// rows instead of two full u32 lanes — at the default 18-bit dict and
+// 10/22-bit packet/byte lanes that is 6.25 B/row vs 8, and the row
+// width shrinks further as deployments tune the dict smaller. The
+// caller's escalation mask must already route rows whose PACKETS or
+// BYTES overflow their lane to the new/full side (engine adds the
+// `bytes >= 1 << by_bits` term for this path), so the stream stores
+// every surviving row exactly.
+//
+// known_out must be ZEROED by the caller and hold at least
+// ceil(n_known * row_bits / 32) + 1 u32 words (the +1 pad word keeps
+// the device unpack's two-word gather in bounds for the last row).
+// Rows are appended in input order through a 128-bit accumulator; bits
+// beyond the last row stay zero, which the device side masks off via
+// the per-device validity count. row_bits = id_bits + pk_bits +
+// by_bits must be <= 64 (id_bits <= 32 always satisfies this at the
+// shipped 10/22 lane widths). Returns n_new.
+long rt_flowwire_dense(const uint32_t* rows, size_t n,
+                       const uint32_t* ids, const uint8_t* sel_new,
+                       uint64_t base, uint32_t id_bits, uint32_t pk_bits,
+                       uint32_t by_bits, uint32_t* new_out,
+                       uint32_t* known_out) {
+  const unsigned row_bits = id_bits + pk_bits + by_bits;
+  size_t n_new = 0, w = 0;
+  unsigned __int128 acc = 0;
+  unsigned acc_bits = 0;
+  for (size_t i = 0; i < n; i++) {
+    const uint32_t* r = rows + i * NUM_FIELDS;
+    if (sel_new[i]) {
+      uint32_t* o = new_out + n_new * 13;
+      o[0] = ids[i];
+      pack_row(r, o + 1, base);
+      n_new++;
+    } else {
+      uint64_t v = (uint64_t)ids[i] |
+                   ((uint64_t)r[F_PACKETS] << id_bits) |
+                   ((uint64_t)r[F_BYTES] << (id_bits + pk_bits));
+      acc |= (unsigned __int128)v << acc_bits;
+      acc_bits += row_bits;
+      while (acc_bits >= 32) {
+        known_out[w++] = (uint32_t)acc;
+        acc >>= 32;
+        acc_bits -= 32;
+      }
+    }
+  }
+  if (acc_bits) known_out[w] = (uint32_t)acc;
+  return (long)n_new;
+}
+
 }  // extern "C"

@@ -5,7 +5,7 @@ scrape-merge + Hubble relay become one device mesh running the fused
 pipeline per-shard with psum/pmax/all_gather merges over ICI/DCN.
 """
 
-from retina_tpu.parallel.mesh import make_mesh  # noqa: F401
+from retina_tpu.parallel.mesh import batch_mesh, make_mesh  # noqa: F401
 from retina_tpu.parallel.partition import (  # noqa: F401
     ShardedBatch,
     canonical_conn_hash,

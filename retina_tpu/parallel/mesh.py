@@ -42,3 +42,15 @@ def make_mesh(
         per = len(devs) // n_nodes
         return Mesh(np.array(devs).reshape(n_nodes, per), ("node", "chip"))
     return Mesh(np.array(devs), ("chip",))
+
+
+def batch_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """1-D ingest mesh named for WHAT is sharded over it: the event
+    batch. ``Mesh(devices, ("batch",))`` with
+    ``NamedSharding(mesh, PartitionSpec("batch"))`` is the data-parallel
+    ingest layout (SNIPPETS.md [2]) — each device holds one feed shard,
+    sketch state merges once per window over the same axis. Identical
+    topology to ``make_mesh(devices)``; the axis name documents intent
+    in every downstream PartitionSpec and jaxpr."""
+    devs = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devs), ("batch",))

@@ -77,6 +77,70 @@ def _aot_disk_bump(field: str) -> None:
         _AOT_DISK_STATS[field] += 1
 
 
+# -- free-function disk layer -----------------------------------------
+# Shared by AotProgram (the telemetry step/end-window programs) and the
+# engine's per-bucket ingest jits (engine._compile_cached): the bucket
+# grid is the bulk of the 214s r05 warm, so it must ride the same disk
+# cache as the step programs for a warm boot to land under 10s.
+
+def aot_disk_path(
+    cache_dir: str, mesh: Mesh, tag: str, config_sig: str, key
+) -> str:
+    """Cache-file path for one (program tag, input-signature) pair,
+    keyed by jax version + backend topology + config signature so a
+    stale entry can never load into a mismatched process."""
+    devs = mesh.devices.ravel()
+    topo = "{}:{}:{}".format(
+        jax.default_backend(), len(devs),
+        getattr(devs[0], "device_kind", "?"),
+    )
+    raw = "|".join((jax.__version__, topo, tag, config_sig, repr(key)))
+    h = hashlib.sha256(raw.encode()).hexdigest()[:32]
+    return os.path.join(cache_dir, f"{tag}-{h}.aotx")
+
+
+def aot_disk_load(path: str):
+    """Deserialize a cached executable, or None (best-effort: stale jax,
+    corrupt/truncated file, incompatible executable all fall back to a
+    fresh compile)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        from jax.experimental import serialize_executable as se
+
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        ex = se.deserialize_and_load(
+            payload["exe"], payload["in_tree"], payload["out_tree"]
+        )
+        _aot_disk_bump("hits")
+        return ex
+    except Exception:
+        _aot_disk_bump("errors")
+        return None
+
+
+def aot_disk_save(path: str, ex) -> None:
+    """Persist a compiled executable (best-effort; never fails the
+    caller — persisting is an optimization only)."""
+    try:
+        from jax.experimental import serialize_executable as se
+
+        payload_exe, in_tree, out_tree = se.serialize(ex)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(
+                {"exe": payload_exe, "in_tree": in_tree,
+                 "out_tree": out_tree},
+                f,
+            )
+        os.replace(tmp, path)
+        _aot_disk_bump("misses")
+    except Exception:
+        _aot_disk_bump("errors")
+
+
 class AotProgram:
     """Aval-keyed AOT executable cache around a jitted program.
 
@@ -126,56 +190,18 @@ class AotProgram:
             for leaf in leaves
         )
 
-    # -- disk layer ----------------------------------------------------
+    # -- disk layer (delegates to the module-level free functions so the
+    # engine's bucket-grid compiles share one format and one stats pool) -
     def _disk_path(self, key) -> str:
-        devs = self._mesh.devices.ravel()
-        topo = "{}:{}:{}".format(
-            jax.default_backend(), len(devs),
-            getattr(devs[0], "device_kind", "?"),
+        return aot_disk_path(
+            self._cache_dir, self._mesh, self._tag, self._config_sig, key
         )
-        raw = "|".join(
-            (jax.__version__, topo, self._tag, self._config_sig, repr(key))
-        )
-        h = hashlib.sha256(raw.encode()).hexdigest()[:32]
-        return os.path.join(self._cache_dir, f"{self._tag}-{h}.aotx")
 
     def _disk_load(self, path: str):
-        if not os.path.exists(path):
-            return None
-        try:
-            from jax.experimental import serialize_executable as se
-
-            with open(path, "rb") as f:
-                payload = pickle.load(f)
-            ex = se.deserialize_and_load(
-                payload["exe"], payload["in_tree"], payload["out_tree"]
-            )
-            _aot_disk_bump("hits")
-            return ex
-        except Exception:
-            # Best-effort by contract (stale jax, corrupt/truncated file,
-            # incompatible executable): fall back to a fresh compile.
-            _aot_disk_bump("errors")
-            return None
+        return aot_disk_load(path)
 
     def _disk_save(self, path: str, ex) -> None:
-        try:
-            from jax.experimental import serialize_executable as se
-
-            payload_exe, in_tree, out_tree = se.serialize(ex)
-            os.makedirs(self._cache_dir, exist_ok=True)
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                pickle.dump(
-                    {"exe": payload_exe, "in_tree": in_tree,
-                     "out_tree": out_tree},
-                    f,
-                )
-            os.replace(tmp, path)
-            _aot_disk_bump("misses")
-        except Exception:
-            # Persisting is an optimization only — never fail the step.
-            _aot_disk_bump("errors")
+        aot_disk_save(path, ex)
 
     def _lower(self, args, key=None):
         if self._cache_dir and key is not None:
@@ -321,6 +347,23 @@ class ShardedTelemetry:
             config_sig=self._config_sig,
         )
 
+    def _put_sharded(self, x):
+        """Place a dim0-sharded step input. Host (numpy/list) batches get
+        an explicit ``device_put`` onto the mesh sharding so each device
+        receives ONLY its shard — ``jnp.asarray`` used to commit the full
+        batch to the default device first and let the executable reshard
+        it, which made the 8-device feed SLOWER than 1 device (the
+        MULTICHIP_r05 replication overhead). Device-resident arrays pass
+        through with a dtype check only — no extra transfer."""
+        if isinstance(x, jax.Array):
+            return x if x.dtype == jnp.uint32 else x.astype(jnp.uint32)
+        host = np.asarray(x, dtype=np.uint32)
+        if self.n_devices == 1:
+            return jnp.asarray(host)
+        return jax.device_put(
+            host, NamedSharding(self.mesh, self._sharded_spec)
+        )
+
     def step(
         self,
         state: PipelineState,
@@ -339,8 +382,8 @@ class ShardedTelemetry:
             filter_map = IdentityMap.zeros(1 << 4, seed=99)
         return self._step(
             state,
-            jnp.asarray(records, jnp.uint32),
-            jnp.asarray(n_valid, jnp.uint32),
+            self._put_sharded(records),
+            self._put_sharded(n_valid),
             jnp.asarray(now_s, jnp.uint32),
             ident,
             jnp.asarray(apiserver_ip, jnp.uint32),

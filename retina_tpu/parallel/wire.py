@@ -90,6 +90,129 @@ def known_rows(
     out[:, 1] = rows[:, F.BYTES]
 
 
+# -- v4 dense known-row bitstream -------------------------------------
+#
+# The v3 known row spends two full u32 lanes per row; at the default
+# 18-bit flow dictionary only 18 + ~14 of the first 32 bits carry
+# information and BYTES almost never needs 32. v4 packs each known row
+# as (id_bits + DENSE_PK_BITS + DENSE_BY_BITS) CONTIGUOUS bits —
+# ``id | packets << id_bits | bytes << (id_bits + DENSE_PK_BITS)`` —
+# streamed into one u32 word array: 50 bits = 6.25 B/row at id_bits=18
+# vs 8, and the row narrows further for smaller dictionaries. Rows
+# whose PACKETS or BYTES overflow their lane escalate to the full
+# 13-word new-row side exactly like the v3 packet-overflow escalation
+# (engine._dispatch_flowdict adds the bytes term to the mask), so the
+# stream stores every surviving row exactly. The +1 pad word keeps the
+# device unpack's two-word gather in bounds for the final row.
+#
+# Three implementations, cross-checked bit-for-bit by
+# tests/test_wire.py: native/pack.cpp rt_flowwire_dense (the fast
+# path), dense_known_rows below (numpy fallback), and
+# dense_known_unpack_device (the device-side reader).
+
+DENSE_PK_BITS = 10
+DENSE_BY_BITS = 22
+
+
+def dense_row_bits(id_bits: int) -> int:
+    """Bits per dense known row. <= 64 for every legal dictionary size
+    (id_bits <= 32)."""
+    return int(id_bits) + DENSE_PK_BITS + DENSE_BY_BITS
+
+
+def dense_words(n_rows: int, id_bits: int) -> int:
+    """u32 words needed for ``n_rows`` dense known rows, including the
+    pad word the device unpack's two-word gather requires."""
+    return (int(n_rows) * dense_row_bits(id_bits) + 31) // 32 + 1
+
+
+def dense_known_rows(
+    rows: np.ndarray, ids: np.ndarray, id_bits: int, out: np.ndarray
+) -> None:
+    """Numpy twin of native rt_flowwire_dense's known side: OR the
+    dense bit rows into the ZEROED 1-D u32 ``out`` stream in row order.
+    Caller guarantees packets < 2**DENSE_PK_BITS and bytes <
+    2**DENSE_BY_BITS (the escalation mask's job)."""
+    k = len(rows)
+    if k == 0:
+        return
+    rb = dense_row_bits(id_bits)
+    v = (
+        ids.astype(np.uint64)
+        | (rows[:, F.PACKETS].astype(np.uint64) << np.uint64(id_bits))
+        | (rows[:, F.BYTES].astype(np.uint64)
+           << np.uint64(id_bits + DENSE_PK_BITS))
+    )
+    p = np.arange(k, dtype=np.uint64) * np.uint64(rb)
+    wi = (p >> np.uint64(5)).astype(np.int64)
+    sh = p & np.uint64(31)
+    # A <=64-bit value shifted by <=31 spans <=3 words; split explicitly
+    # (v << sh would overflow u64 for sh > 64 - rb).
+    lo = ((v & _U32) << sh) & _U32
+    mid = (v >> (np.uint64(32) - sh)) & _U32  # sh==0 -> v >> 32: word 1
+    hi_sh = np.where(sh > 0, np.uint64(64) - sh, np.uint64(63))
+    hi = np.where(sh > 0, v >> hi_sh, np.uint64(0))
+    np.bitwise_or.at(out, wi, lo.astype(np.uint32))
+    np.bitwise_or.at(out, wi + 1, mid.astype(np.uint32))
+    np.bitwise_or.at(out, wi + 2, hi.astype(np.uint32))
+
+
+def dense_known_unpack_device(words, n_rows: int, id_bits: int):
+    """jax: dense known stream -> (ids, packets, bytes), each (..., n).
+
+    ``words`` is (..., W) u32 (per-device streams stack on the leading
+    axis); gathers two words per field and shifts them together — every
+    field is <= 32 bits wide, so two words always suffice. Runs inside
+    the engine's known-ingest jit.
+    """
+    rb = dense_row_bits(id_bits)
+    i = jnp.arange(n_rows, dtype=jnp.uint32)
+
+    def field(off: int, width: int):
+        p = i * np.uint32(rb) + np.uint32(off)
+        wi = (p >> np.uint32(5)).astype(jnp.int32)
+        sh = p & np.uint32(31)
+        lo = words[..., wi] >> sh
+        up = words[..., wi + 1]
+        # sh==0 would shift by 32 (undefined); (32-sh)&31 makes it a
+        # shift by 0 and the where() discards the lane.
+        up = jnp.where(
+            sh > 0, up << ((np.uint32(32) - sh) & np.uint32(31)), 0
+        )
+        return (lo | up) & np.uint32((1 << width) - 1)
+
+    return (
+        field(0, id_bits),
+        field(id_bits, DENSE_PK_BITS),
+        field(id_bits + DENSE_PK_BITS, DENSE_BY_BITS),
+    )
+
+
+def dense_known_unpack_numpy(
+    words: np.ndarray, n_rows: int, id_bits: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host mirror of dense_known_unpack_device (tests)."""
+    rb = dense_row_bits(id_bits)
+    i = np.arange(n_rows, dtype=np.uint32)
+
+    def field(off: int, width: int) -> np.ndarray:
+        p = i * np.uint32(rb) + np.uint32(off)
+        wi = (p >> np.uint32(5)).astype(np.int64)
+        sh = p & np.uint32(31)
+        lo = words[..., wi] >> sh
+        up = words[..., wi + 1]
+        up = np.where(
+            sh > 0, up << ((np.uint32(32) - sh) & np.uint32(31)), 0
+        ).astype(np.uint32)
+        return (lo | up) & np.uint32((1 << width) - 1)
+
+    return (
+        field(0, id_bits),
+        field(id_bits, DENSE_PK_BITS),
+        field(id_bits + DENSE_PK_BITS, DENSE_BY_BITS),
+    )
+
+
 def pack_records(
     records: np.ndarray, base: np.uint64 | None = None
 ) -> tuple[np.ndarray, np.uint32, np.uint32]:

@@ -336,18 +336,29 @@ def test_combine_blocks_bit_identical_to_concat():
     empty = gen.batch(1)[:0]
     assert len(combine_blocks([empty, empty.copy()])) == 0
 
-    # Multi-core regime: combine_blocks must route through the MT
-    # concat path (where chunk-major order makes the single-thread
-    # multi-block pass non-comparable) — the contract holds because it
-    # literally IS concat + combine_records there.
+    # Multi-core regime: combine_blocks routes through the STRIPED
+    # multi-consumer path, whose row order is stripe-major and
+    # explicitly arbitrary — the contract there is the key ->
+    # (packets, bytes, latest-ts) map, not row order (the deeper
+    # order-insensitive coverage lives in test_combine_scaling.py).
+    from retina_tpu.events.schema import F
     from retina_tpu.native import get_combine_threads, set_combine_threads
+    from retina_tpu.parallel.combine import KEY_COLS
+
+    def as_map(arr):
+        return {
+            tuple(int(x) for x in r[list(KEY_COLS)]):
+                (int(r[F.PACKETS]), int(r[F.BYTES]),
+                 int(r[F.TS_HI]) << 32 | int(r[F.TS_LO]))
+            for r in arr
+        }
 
     prev = get_combine_threads()
     try:
         set_combine_threads(4)
         big = [gen.batch(1 << 14) for _ in range(6)]  # >= MT threshold
-        np.testing.assert_array_equal(
-            combine_blocks(big), combine_records(np.concatenate(big))
+        assert as_map(combine_blocks(big)) == as_map(
+            combine_records(np.concatenate(big))
         )
     finally:
         set_combine_threads(prev)
@@ -478,3 +489,20 @@ def test_combine_mt_equivalent_across_thread_counts():
         assert got == ref, f"threads={threads}"
     # Hinted + threaded compose.
     assert as_map(run(4, hint=8192)) == ref
+
+
+def test_loaded_abi_version_matches_headers():
+    """The loaded libretina_native.so must export exactly the ABI the
+    Python loader was written against — a stale .so (rebuilt headers,
+    old binary) must be a loud failure here, not a silent fallback in
+    production. The loader itself force-rebuilds on mismatch, so this
+    asserts the END state: whatever got loaded agrees."""
+    from retina_tpu.native import (
+        NATIVE_ABI_VERSION,
+        get_lib,
+        native_abi_version,
+    )
+
+    if get_lib() is None:
+        pytest.skip("native library unavailable")
+    assert native_abi_version() == NATIVE_ABI_VERSION

@@ -569,6 +569,10 @@ def _engine_stub(mesh: Mesh):
     eng._fd_id_bits = max(
         1, (eng.cfg.flow_dict_slots - 1).bit_length()
     )
+    # Audit the DEFAULT wire shape (v4 dense known stream); the stub
+    # never touches a disk cache, so the AOT signature is inert here.
+    eng._fd_dense = bool(eng.cfg.wire_dense_known)
+    eng._aot_sig = ""
     return eng
 
 
