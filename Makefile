@@ -2,7 +2,7 @@
 # (.github/workflows/); the driver runs bench.py directly.
 
 .PHONY: test native bench bench-smoke soak distributed chaos lint \
-	analyze-device clean
+	analyze-device query-dryrun clean
 
 native:
 	$(MAKE) -C retina_tpu/native
@@ -16,6 +16,11 @@ bench: native
 
 bench-smoke: native
 	python bench.py --smoke
+
+# Time-travel closed loop: burst detection -> range-query attribution
+# -> targeted capture, with the query API under concurrent load.
+query-dryrun: native
+	python bench.py --query-dryrun
 
 # 5-minute paced soak with rate/loss/RSS/scrape budgets.
 soak: native

@@ -1025,9 +1025,34 @@ def main() -> None:
                          "aggregator decodes heavy-flow keys from the "
                          "merged invertible sketch, through a forced "
                          "SHEDDING episode")
+    ap.add_argument("--query-dryrun", action="store_true",
+                    help="time-travel closed-loop dryrun: an entropy "
+                         "burst is detected, the query ring is folded "
+                         "over [W-2, W+2), burst sources are attributed "
+                         "via invertible decode, and a targeted capture "
+                         "artifact is produced — while concurrent "
+                         "scrapes (half under forced SHEDDING) hammer "
+                         "the query API")
     args = ap.parse_args()
     try:
-        if args.invertible_dryrun:
+        if args.query_dryrun:
+            from retina_tpu.timetravel.dryrun import run_query_dryrun
+
+            res = run_query_dryrun(log=log)
+            out = {
+                # Acceptance: the whole detection -> attribution ->
+                # evidence arc, with decode recall >= 0.95 against the
+                # exact attack key set and query p99 bounded while the
+                # feed runs at full rate.
+                "metric": "timetravel_decode_recall",
+                "value": res["recall"],
+                "unit": "recall",
+                "vs_baseline": round(res["recall"] / 0.95, 4),
+                "extra": res,
+            }
+            if not res["ok"]:
+                out["error"] = "query dryrun acceptance failed"
+        elif args.invertible_dryrun:
             from retina_tpu.fleet.dryrun import run_invertible_dryrun
 
             res = run_invertible_dryrun(

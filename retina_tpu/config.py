@@ -94,6 +94,13 @@ class Config:
     # generator out of the hot loop for max-rate benchmarking — the
     # trafficgen-replay analog.
     synthetic_pregen: int = 0
+    # Generator regime preset (events/synthetic.py PRESETS): "default"
+    # keeps the generator's own parameters; "zipf" is the heavy-tail
+    # regime (steeper Zipf exponent, fewer dominating flows — the
+    # PSketch-style skew the detector/attribution arc is validated
+    # against); "uniform" flattens the flow-size distribution (the
+    # worst case for top-k recall).
+    gen_preset: str = "default"
     capture_iface: str = ""  # live AF_PACKET interface ("" = default)
     external_socket: str = "/tmp/retina-events.sock"  # external feed
     # Cilium agent monitor socket (gob payload stream) for the
@@ -340,6 +347,35 @@ class Config:
     # Max tenants exported per epoch; lowest-priority shed first.
     fleet_max_tenants: int = 16
 
+    # --- time-travel query ring (timetravel/) ---
+    # Retain the last N window-close sketch exports in a bounded ring
+    # and serve [t0, t1) range queries over them (one jitted
+    # semilattice fold). Off by default: the ring holds ~N x the
+    # fleet-export footprint in host memory.
+    timetravel_enabled: bool = False
+    timetravel_ring_windows: int = 32  # ring capacity (slots)
+    # Range-query result cache TTL; concurrent/overlapping queries are
+    # served from cache so at most one fold runs at a time (the p99
+    # bound). Under SHEDDING the TTL is ignored (serve stale freely).
+    timetravel_query_cache_ttl_s: float = 1.0
+    timetravel_query_topk: int = 32  # default k for /timetravel/query
+
+    # --- closed-loop capture (timetravel/autocapture.py) ---
+    # When the entropy burst detector fires, pivot the query ring to
+    # the burst range, attribute sources via invertible decode, and
+    # record a targeted capture of only the attributed keys. Needs
+    # timetravel_enabled + enable_invertible for attribution.
+    autocapture_enabled: bool = False
+    autocapture_cooldown_s: float = 60.0  # min spacing between captures
+    # Query range around burst window W: [W - lookback, W + lookahead].
+    autocapture_lookback_windows: int = 2
+    autocapture_lookahead_windows: int = 1
+    autocapture_max_sources: int = 8  # top attributed src IPs captured
+    autocapture_duration_s: float = 2.0  # capture recording window
+    autocapture_max_size_mb: int = 8  # evidence bound: a few MB
+    # Artifact sink directory (capture host_path output).
+    autocapture_output_dir: str = "/tmp/retina-autocapture"
+
     # --- pipeline shapes (jit keys; see models/pipeline.py) ---
     n_pods: int = 1 << 12
     cms_width: int = 1 << 15
@@ -447,6 +483,30 @@ class Config:
                 raise ValueError(
                     f"{f} must be >= 0, got {getattr(self, f)}"
                 )
+        if self.gen_preset not in ("default", "zipf", "uniform"):
+            raise ValueError(
+                "gen_preset must be 'default', 'zipf' or 'uniform', "
+                f"got {self.gen_preset!r}"
+            )
+        for f in ("timetravel_ring_windows", "timetravel_query_topk",
+                  "autocapture_max_sources", "autocapture_max_size_mb"):
+            if getattr(self, f) < 1:
+                raise ValueError(
+                    f"{f} must be >= 1, got {getattr(self, f)}"
+                )
+        for f in ("timetravel_query_cache_ttl_s",
+                  "autocapture_cooldown_s",
+                  "autocapture_lookback_windows",
+                  "autocapture_lookahead_windows"):
+            if getattr(self, f) < 0:
+                raise ValueError(
+                    f"{f} must be >= 0, got {getattr(self, f)}"
+                )
+        if self.autocapture_duration_s <= 0:
+            raise ValueError(
+                f"autocapture_duration_s must be > 0, "
+                f"got {self.autocapture_duration_s}"
+            )
         if self.heavy_keys_source not in ("flowdict", "invertible", "both"):
             raise ValueError(
                 "heavy_keys_source must be 'flowdict', 'invertible' or "

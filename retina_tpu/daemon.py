@@ -101,6 +101,40 @@ class Daemon:
             self.fleet_aggregator = FleetAggregator(
                 cfg, supervisor=self.cm.supervisor
             )
+        # Time-travel query tier (timetravel/): one QueryService owns
+        # the jitted fold cache and every ring in this process — the
+        # engine's per-window ring, plus a merged-epoch ring when the
+        # aggregator role is on. The closed loop (autocapture) rides
+        # the same service.
+        self.query_service = None
+        self.autocapture = None
+        if cfg.timetravel_enabled:
+            from retina_tpu.timetravel.query import QueryService
+            from retina_tpu.timetravel.ring import SnapshotRing
+
+            self.query_service = QueryService(
+                cfg, overload=self.cm.engine._overload
+            )
+            if self.cm.engine.timetravel_ring is not None:
+                self.query_service.add_ring(
+                    self.cm.engine.timetravel_ring
+                )
+            if self.fleet_aggregator is not None:
+                fleet_ring = SnapshotRing(
+                    cfg.timetravel_ring_windows, name="fleet",
+                    supervisor=self.cm.supervisor,
+                )
+                self.fleet_aggregator.timetravel_ring = fleet_ring
+                self.query_service.add_ring(fleet_ring)
+            if cfg.autocapture_enabled:
+                from retina_tpu.timetravel.autocapture import AutoCapture
+
+                self.autocapture = AutoCapture(
+                    cfg, self.query_service, ring_name="engine",
+                    engine=self.cm.engine,
+                    supervisor=self.cm.supervisor,
+                )
+                self.cm.engine.anomaly_hook = self.autocapture.notify
         if cfg.enable_hubble:
             # Hubble CP rides alongside (cmd/hubble cell graph analog):
             # plugins mirror events into the external channel; the monitor
@@ -257,6 +291,13 @@ class Daemon:
             self.cm.server.expose_var(
                 "traces_stats", self.traces_module.stats
             )
+        if self.query_service is not None and self.cm.server is not None:
+            # /timetravel/query + the ring debug var ride the existing
+            # agent mux; registration is a dict insert, safe while the
+            # server serves.
+            self.query_service.attach(self.cm.server)
+        if self.autocapture is not None:
+            self.autocapture.start()
         if self.monitoragent is not None:
             self.monitoragent.start(stop)
         if self.fleet_aggregator is not None:
@@ -308,6 +349,11 @@ class Daemon:
                     self.hubble_metrics_server.stop()
             if self.fleet_aggregator is not None:
                 self.fleet_aggregator.stop()
+                ring = self.fleet_aggregator.timetravel_ring
+                if ring is not None:
+                    ring.stop()
+            if self.autocapture is not None:
+                self.autocapture.stop()
 
 
 def run_agent(

@@ -71,6 +71,7 @@ ENTRY_MODULES = (
     "retina_tpu.parallel.telemetry",
     "retina_tpu.engine",
     "retina_tpu.fleet.aggregator",
+    "retina_tpu.timetravel.fold",
 )
 
 

@@ -315,6 +315,24 @@ class SketchEngine:
             self._fleet_shipper = SnapshotShipper(
                 cfg, overload=self._overload, supervisor=self._supervisor
             )
+        # Time-travel snapshot ring (timetravel/): retain the same
+        # window-close export the fleet shipper puts on the wire, as N
+        # host-side slots served to the range-query API. Shares the
+        # shipper's offer/worker shape: O(1) enqueue on the close lane,
+        # readback off-proxy.
+        self._tt_ring: Any = None
+        if cfg.timetravel_enabled:
+            from retina_tpu.timetravel.ring import SnapshotRing
+
+            self._tt_ring = SnapshotRing(
+                cfg.timetravel_ring_windows, name="engine",
+                overload=self._overload, supervisor=self._supervisor,
+            )
+        # Closed-loop capture hook (timetravel/autocapture.py): the
+        # daemon wires AutoCapture.notify here; called from the harvest
+        # thread when the entropy detector flags a window (must never
+        # block — notify only enqueues).
+        self.anomaly_hook: Any = None
         # Protected close lane: window ticks acquire THIS semaphore,
         # never the step in-flight one — a saturated step pipeline can
         # delay a close behind queued transfers but can never starve it
@@ -1867,6 +1885,21 @@ class SketchEngine:
                 # Counter survives scrape cadence: a 0.2s anomalous
                 # window must be visible at a 30s scrape.
                 m.anomaly_windows.labels(dimension=dim).inc()
+        flagged = [
+            dim for i, dim in enumerate(dims)
+            if i < len(win_host["anomaly"]) and win_host["anomaly"][i]
+        ]
+        if flagged and self.anomaly_hook is not None:
+            # Closed-loop capture pivot (timetravel/autocapture.py):
+            # notify only enqueues — the harvest thread never waits on
+            # attribution or capture work.
+            try:
+                self.anomaly_hook(
+                    fleet_epoch(self.cfg.window_seconds), flagged
+                )
+            except Exception:
+                if self._count_error("anomaly_hook"):
+                    self.log.exception("anomaly hook failed")
 
     def _ensure_harvest_thread(self) -> None:
         # Spawn-vs-retire is serialized by _harvest_lock: without it a
@@ -2096,21 +2129,29 @@ class SketchEngine:
         def close():
             self._device_consts()
             with self._state_lock:
-                if self._fleet_shipper is not None:
-                    # Fleet export MUST dispatch before end_window:
-                    # end_window resets the entropy window and donates
-                    # the state buffers, so this is the last moment the
-                    # closing window's sketches exist on device. Pure
-                    # dispatch — the shipper worker does the blocking
-                    # readback off the proxy; offer() never blocks.
+                if (self._fleet_shipper is not None
+                        or self._tt_ring is not None):
+                    # Export MUST dispatch before end_window: end_window
+                    # resets the entropy window and donates the state
+                    # buffers, so this is the last moment the closing
+                    # window's sketches exist on device. Pure dispatch —
+                    # one export feeds both the fleet shipper and the
+                    # time-travel ring; their workers do the blocking
+                    # readback off the proxy, and offer() never blocks.
                     try:
                         export = self.sharded.fleet_export(self.state)
-                        self._fleet_shipper.offer(
-                            fleet_epoch(self.cfg.window_seconds),
-                            export,
-                            self.cfg.window_seconds,
-                            self.sharded.fleet_seeds(self.state),
-                        )
+                        epoch = fleet_epoch(self.cfg.window_seconds)
+                        seeds = self.sharded.fleet_seeds(self.state)
+                        if self._fleet_shipper is not None:
+                            self._fleet_shipper.offer(
+                                epoch, export,
+                                self.cfg.window_seconds, seeds,
+                            )
+                        if self._tt_ring is not None:
+                            self._tt_ring.offer(
+                                epoch, export,
+                                self.cfg.window_seconds, seeds,
+                            )
                     except Exception:
                         get_metrics().fleet_ship_errors.inc()
                         if self._count_error("fleet_export"):
@@ -2363,6 +2404,8 @@ class SketchEngine:
         self.started.set()
         if self._fleet_shipper is not None:
             self._fleet_shipper.start()
+        if self._tt_ring is not None:
+            self._tt_ring.start()
         cap = self.cfg.batch_capacity * self.n_devices
         # Flush threshold: accumulating beyond one device batch raises the
         # combine ratio (more duplicate descriptors per pass); the
@@ -2650,6 +2693,16 @@ class SketchEngine:
             # ships before the worker parks.
             if self._fleet_shipper is not None:
                 self._fleet_shipper.stop()
+            # Same ordering for the time-travel ring: the final close's
+            # export is queued before the fence returns.
+            if self._tt_ring is not None:
+                self._tt_ring.stop()
+
+    @property
+    def timetravel_ring(self):
+        """The engine's snapshot ring (None unless timetravel_enabled);
+        the daemon wires it into the QueryService."""
+        return self._tt_ring
 
     # -- scrape-time readout -----------------------------------------
     def snapshot(self, max_age_s: float = 0.5) -> dict[str, Any]:

@@ -38,6 +38,27 @@ from retina_tpu.events.schema import (
 
 POD_NET = 0x0A000000  # 10.0.0.0/8: pod IPs are POD_NET + pod_index
 
+# Generator regime presets (cfg.gen_preset): parameter overrides
+# applied on top of the TrafficGen defaults. "zipf" is the heavy-tail
+# regime the detector/attribution arc is validated against — a steeper
+# exponent concentrates traffic on a handful of flows (the PSketch
+# skew on real eBPF feeds); "uniform" flattens the flow-size
+# distribution toward the top-k worst case. "default" applies nothing.
+PRESETS: dict[str, dict[str, float]] = {
+    "default": {},
+    "zipf": {"zipf_a": 1.6},
+    "uniform": {"zipf_a": 1.001},
+}
+
+
+def preset_params(name: str) -> dict[str, float]:
+    """Overrides for one preset; unknown names raise (config.validate
+    rejects them earlier — this guards direct library callers)."""
+    try:
+        return dict(PRESETS[name])
+    except KeyError:
+        raise ValueError(f"unknown gen_preset {name!r}") from None
+
 
 def pod_ip(index: int) -> int:
     return POD_NET + index

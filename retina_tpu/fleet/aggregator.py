@@ -88,6 +88,11 @@ class FleetAggregator:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._sub_id: str | None = None
+        # Time-travel ring for merged epochs (timetravel/ring.py), set
+        # by the daemon when timetravel_enabled: each merged epoch's
+        # arrays are retained as a fleet-ring slot so range queries
+        # cover cluster history, not just this node's.
+        self.timetravel_ring: Any = None
         # Rolling window of recent rollups for tests/dryrun/debug vars.
         self.rollups: list[dict] = []
         self.epochs_merged = 0
@@ -299,6 +304,22 @@ class FleetAggregator:
         }
         seeds = snaps[0].seeds
         merged = self._merge_fn(len(snaps), seeds, tuple(names))(stacked)
+        if self.timetravel_ring is not None:
+            # Merged-epoch snapshot into the fleet ring: already a
+            # valid fold operand (same algebra, same catalog), so
+            # cluster-wide range queries are one more fold away. Host
+            # readback here is fine — the poll thread does host work
+            # for the rollup anyway.
+            try:
+                self.timetravel_ring.append_host(
+                    epoch,
+                    {k: np.asarray(v) for k, v in merged.items()},
+                    float(snaps[0].window_s),
+                    dict(seeds),
+                )
+            except Exception:
+                if rate_limited("fleet.ttring"):
+                    self.log.exception("timetravel ring append failed")
         rollup = self._rollup(epoch, snaps, merged, seeds)
         rollup["straggled"] = straggled
         rollup["merge_seconds"] = time.monotonic() - t0

@@ -180,6 +180,33 @@ class Metrics:
         self.fleet_invertible_decode_failed = c(
             mn.FLEET_INVERTIBLE_DECODE_FAILED, []
         )
+        # Time-travel query ring + closed-loop capture (timetravel/).
+        self.timetravel_ring_appended = c(
+            mn.TIMETRAVEL_RING_APPENDED, [mn.L_RING]
+        )
+        self.timetravel_ring_dropped = c(
+            mn.TIMETRAVEL_RING_DROPPED, [mn.L_RING]
+        )
+        self.timetravel_ring_depth = g(
+            mn.TIMETRAVEL_RING_DEPTH, [mn.L_RING]
+        )
+        self.timetravel_queries = c(mn.TIMETRAVEL_QUERIES, [mn.L_STATUS])
+        self.timetravel_query_seconds = ex.new_histogram(
+            mn.TIMETRAVEL_QUERY_SECONDS, [],
+            buckets=[1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0, 3.0],
+        )
+        self.timetravel_query_windows = g(mn.TIMETRAVEL_QUERY_WINDOWS, [])
+        self.autocapture_triggered = c(mn.AUTOCAPTURE_TRIGGERED, [])
+        self.autocapture_suppressed = c(
+            mn.AUTOCAPTURE_SUPPRESSED, [mn.L_REASON]
+        )
+        self.autocapture_completed = c(mn.AUTOCAPTURE_COMPLETED, [])
+        self.autocapture_failed = c(mn.AUTOCAPTURE_FAILED, [])
+        self.autocapture_attributed_keys = g(mn.AUTOCAPTURE_KEYS, [])
+        self.autocapture_artifact_bytes = g(
+            mn.AUTOCAPTURE_ARTIFACT_BYTES, []
+        )
+        self.autocapture_last_epoch = g(mn.AUTOCAPTURE_LAST_EPOCH, [])
 
 
 _singleton: Metrics | None = None

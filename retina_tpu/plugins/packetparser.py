@@ -25,7 +25,7 @@ import time
 import numpy as np
 
 from retina_tpu.config import Config
-from retina_tpu.events.synthetic import TrafficGen
+from retina_tpu.events.synthetic import TrafficGen, preset_params
 from retina_tpu.plugins import registry
 from retina_tpu.plugins.api import Plugin, UnsupportedPlatform
 
@@ -65,8 +65,14 @@ class PacketParserPlugin(Plugin):
         src = self.cfg.event_source
         if src == "synthetic":
             self._gen = TrafficGen(
-                n_flows=self.cfg.synthetic_flows, n_pods=self.cfg.n_pods
+                n_flows=self.cfg.synthetic_flows, n_pods=self.cfg.n_pods,
+                **preset_params(self.cfg.gen_preset),
             )
+            if self.cfg.gen_preset != "default":
+                self.log.info(
+                    "generator preset %r: %s", self.cfg.gen_preset,
+                    preset_params(self.cfg.gen_preset),
+                )
             if self.cfg.synthetic_pregen > 0:
                 self._pregen = []
         elif src == "pcap":

@@ -49,6 +49,13 @@ class Server:
         self._ready = ready_check or (lambda: True)
         self._healthy = healthy_check or (lambda: True)
         self._vars: dict[str, Callable[[], object]] = {}
+        # Extension GET routes registered by subsystems (timetravel
+        # query API): path -> fn(query_dict) -> (code, body, ctype).
+        # Populated before start() or from single daemon-thread wiring;
+        # read-only lookups on handler threads thereafter.
+        self._routes: dict[
+            str, Callable[[dict], tuple[int, bytes, str]]
+        ] = {}
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         # Rendering ~50k pod-level series is Python-heavy (~0.5s at 2k
@@ -145,6 +152,17 @@ class Server:
         """Register a /debug/vars entry (expvar analog)."""
         self._vars[name] = fn
 
+    def register_route(
+        self,
+        path: str,
+        fn: Callable[[dict], tuple[int, bytes, str]],
+    ) -> None:
+        """Register an extension GET route (chi-mux ``mux.Handle``
+        analog). ``fn`` receives the parsed query dict (parse_qs form:
+        name -> list of values) and returns (status, body, ctype); it
+        runs on handler threads and must bound its own latency."""
+        self._routes[path.rstrip("/") or "/"] = fn
+
     @property
     def port(self) -> int:
         """Bound port (useful when constructed with port 0 in tests)."""
@@ -210,6 +228,11 @@ class Server:
                         snap = tracemalloc.take_snapshot()
                         lines = [str(s) for s in snap.statistics("lineno")[:50]]
                         self._send(200, "\n".join(lines).encode(), "text/plain")
+                    elif route in srv._routes:
+                        code, body, ctype = srv._routes[route](
+                            parse_qs(url.query)
+                        )
+                        self._send(code, body, ctype)
                     else:
                         self._send(404, b"not found", "text/plain")
                 except BrokenPipeError:  # noqa: RT101 — client hung up mid-response

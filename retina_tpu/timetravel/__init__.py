@@ -1,0 +1,19 @@
+"""timetravel: range queries over windowed sketch history + closed loop.
+
+Sketches merge across *time* as well as space (Sketchy, PAPERS.md): a
+per-window snapshot of the engine's sketch state is itself a valid
+operand of the same semilattice algebra the fleet tier already folds
+across nodes. This package keeps a bounded ring of those snapshots
+(ring.py), answers ad-hoc ``[t0, t1)`` range queries as ONE jitted fold
+over the selected slots (fold.py, registered as
+``timetravel.range_fold`` so RT300/RT305 verify the algebra), serves
+them through a bounded-latency HTTP endpoint (query.py), and closes the
+reference's capture loop (autocapture.py): entropy burst detected →
+ring pivoted to the offending windows → sources attributed via
+invertible decode → targeted capture of only the attributed keys.
+"""
+
+from retina_tpu.timetravel.fold import RangeFold
+from retina_tpu.timetravel.ring import SnapshotRing
+
+__all__ = ["RangeFold", "SnapshotRing"]

@@ -1,0 +1,208 @@
+"""Bounded ring of per-window sketch snapshots.
+
+One ring instance holds the last N windows of sketch state for one
+producer — the engine (per window close) or the fleet aggregator (per
+merged epoch). Slots follow the fleet array catalog (fleet/codec.py),
+so any contiguous run of slots is a valid operand set for the
+``timetravel.range_fold`` program and any slot is RFLT-encodable
+as-is.
+
+Close-lane contract (the repo-wide rule): ``offer`` runs on the device
+proxy inside the window-close dispatch and must never block — it
+enqueues and returns; a worker thread does the device readback
+(fetch_on_device per leaf) OFF the proxy and appends to the ring. A
+full queue drops the snapshot and counts it. Producers that already
+hold host arrays (the aggregator) append directly with
+``append_host`` — O(1), no thread hop.
+
+Memory bound: ``capacity`` slots × the per-window export size (the
+same arrays the fleet shipper puts on the wire). Eviction is implicit
+— the deque's maxlen drops the oldest slot on append.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue as queue_mod
+import threading
+from typing import Any
+
+import numpy as np
+
+from retina_tpu.log import logger, rate_limited
+from retina_tpu.metrics import get_metrics
+from retina_tpu.utils.device_proxy import fetch_on_device
+
+
+class SnapshotRing:
+    """Thread-safe bounded window-snapshot history for one producer."""
+
+    def __init__(
+        self,
+        capacity: int,
+        name: str = "engine",
+        overload=None,  # OverloadController (state read only)
+        supervisor=None,  # runtime/supervisor.py Supervisor
+        queue_size: int = 4,
+    ) -> None:
+        self.name = name
+        self.capacity = max(1, int(capacity))
+        self.log = logger(f"timetravel.ring.{name}")
+        self._overload = overload
+        self._supervisor = supervisor
+        # deque(maxlen) gives O(1) append WITH implicit oldest-slot
+        # eviction; slots stay epoch-sorted because producers append in
+        # close order.
+        self._slots: collections.deque = collections.deque(
+            maxlen=self.capacity
+        )
+        self._lock = threading.Lock()
+        self._q: queue_mod.Queue = queue_mod.Queue(
+            maxsize=max(1, int(queue_size))
+        )
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.appended = 0  # slots landed (tests/dryrun)
+        self.evicted = 0
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=f"tt-ring-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        self._q.put(None)  # wake the worker
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+        if self._supervisor is not None and self._thread is not None:
+            self._supervisor.deregister(f"tt-ring-{self.name}")
+        self._thread = None
+
+    # -- close-path entry (device-proxy thread; must never block) ------
+    def offer(
+        self,
+        epoch: int,
+        arrays: dict[str, Any],
+        window_s: float,
+        seeds: dict[str, int],
+    ) -> bool:  # runs-on: device-proxy
+        """Enqueue one window's export for ring retention. ``arrays``
+        values may be device arrays (fetched on the worker) or host
+        numpy. Returns False when dropped (queue full / stopped).
+
+        No SHEDDING backoff here on purpose: the ring is the evidence
+        trail the autocapture loop pivots to when the system is under
+        attack — exactly when overload states fire — and retention is
+        local memory, not wire traffic. Overload protection is the
+        bounded queue itself.
+        """
+        if self._stop.is_set():
+            return False
+        try:
+            self._q.put_nowait((epoch, arrays, window_s, seeds))
+            return True
+        except queue_mod.Full:
+            m = get_metrics()
+            m.timetravel_ring_dropped.labels(ring=self.name).inc()
+            if rate_limited("timetravel.ring_full"):
+                self.log.warning(
+                    "ring readback queue full; dropping epoch %d", epoch
+                )
+            return False
+
+    def append_host(
+        self,
+        epoch: int,
+        arrays: dict[str, np.ndarray],
+        window_s: float,
+        seeds: dict[str, int],
+    ) -> None:
+        """Direct O(1) append of already-host arrays (aggregator path,
+        tests). Safe from any thread."""
+        with self._lock:
+            if len(self._slots) == self._slots.maxlen:
+                self.evicted += 1
+            self._slots.append(
+                (int(epoch), arrays, float(window_s), dict(seeds))
+            )
+            self.appended += 1
+            depth = len(self._slots)
+        m = get_metrics()
+        m.timetravel_ring_appended.labels(ring=self.name).inc()
+        m.timetravel_ring_depth.labels(ring=self.name).set(depth)
+
+    # -- worker --------------------------------------------------------
+    def _run(self) -> None:  # runs-on: tt-ring
+        hb = None
+        if self._supervisor is not None:
+            hb = self._supervisor.register(
+                f"tt-ring-{self.name}", 60.0
+            )
+        while not self._stop.is_set():
+            if hb is not None:
+                hb.park()
+            item = self._q.get()
+            if item is None or self._stop.is_set():
+                break
+            if hb is not None:
+                hb.beat()
+            try:
+                epoch, arrays, window_s, seeds = item
+                host: dict[str, np.ndarray] = {}
+                for name, arr in arrays.items():
+                    if isinstance(arr, np.ndarray):
+                        host[name] = arr
+                    else:
+                        host[name] = fetch_on_device(arr)
+                self.append_host(epoch, host, window_s, seeds)
+            except Exception:
+                get_metrics().timetravel_ring_dropped.labels(
+                    ring=self.name
+                ).inc()
+                if rate_limited("timetravel.ring_readback"):
+                    self.log.exception("ring snapshot readback failed")
+
+    # -- queries -------------------------------------------------------
+    def select(
+        self, e0: int, e1: int
+    ) -> list[tuple[int, dict[str, np.ndarray], float, dict[str, int]]]:
+        """Slots with epoch in ``[e0, e1)``, oldest first. Returns
+        copies of the slot tuples (the arrays themselves are shared,
+        immutable-by-convention host buffers)."""
+        with self._lock:
+            return [s for s in self._slots if e0 <= s[0] < e1]
+
+    def span(self) -> tuple[int, int]:
+        """(oldest_epoch, newest_epoch) currently retained, or
+        (-1, -1) when empty."""
+        with self._lock:
+            if not self._slots:
+                return (-1, -1)
+            return (self._slots[0][0], self._slots[-1][0])
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    # -- observability -------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            depth = len(self._slots)
+            oldest = self._slots[0][0] if depth else -1
+            newest = self._slots[-1][0] if depth else -1
+        return {
+            "ring": self.name,
+            "capacity": self.capacity,
+            "depth": depth,
+            "oldest_epoch": oldest,
+            "newest_epoch": newest,
+            "appended": self.appended,
+            "evicted": self.evicted,
+            "queue_depth": self._q.qsize(),
+        }

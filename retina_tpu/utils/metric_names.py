@@ -192,6 +192,36 @@ FLEET_INVERTIBLE_DECODE_FAILED = (
     FLEET_PREFIX + "invertible_decode_failed_counter"
 )
 
+# Time-travel query ring (retina_tpu/timetravel): ring_appended/
+# ring_dropped/ring_depth track each bounded snapshot ring (label
+# ring=engine|fleet — fixed set, one per producer); queries counts
+# range-query requests by terminal status (ok/stale/busy/empty/
+# bad_request/error — fixed set), query_seconds is the HTTP handler
+# latency histogram the p99 bound is read from, query_windows the slot
+# count folded by the last query.
+TIMETRAVEL_PREFIX = PREFIX + "tpu_timetravel_"
+TIMETRAVEL_RING_APPENDED = TIMETRAVEL_PREFIX + "ring_appended_counter"
+TIMETRAVEL_RING_DROPPED = TIMETRAVEL_PREFIX + "ring_dropped_counter"
+TIMETRAVEL_RING_DEPTH = TIMETRAVEL_PREFIX + "ring_depth"
+TIMETRAVEL_QUERIES = TIMETRAVEL_PREFIX + "queries_counter"
+TIMETRAVEL_QUERY_SECONDS = TIMETRAVEL_PREFIX + "query_seconds"
+TIMETRAVEL_QUERY_WINDOWS = TIMETRAVEL_PREFIX + "query_windows"
+
+# Closed-loop capture (timetravel/autocapture.py): triggered counts
+# detector firings accepted for capture; suppressed counts firings
+# absorbed by reason (cooldown/busy/no_keys — fixed set); completed/
+# failed count finished capture jobs; attributed_keys and
+# artifact_bytes describe the last completed capture; last_epoch is
+# the burst window-epoch it covered.
+AUTOCAPTURE_PREFIX = PREFIX + "tpu_autocapture_"
+AUTOCAPTURE_TRIGGERED = AUTOCAPTURE_PREFIX + "triggered_counter"
+AUTOCAPTURE_SUPPRESSED = AUTOCAPTURE_PREFIX + "suppressed_counter"
+AUTOCAPTURE_COMPLETED = AUTOCAPTURE_PREFIX + "completed_counter"
+AUTOCAPTURE_FAILED = AUTOCAPTURE_PREFIX + "failed_counter"
+AUTOCAPTURE_KEYS = AUTOCAPTURE_PREFIX + "attributed_keys"
+AUTOCAPTURE_ARTIFACT_BYTES = AUTOCAPTURE_PREFIX + "artifact_bytes"
+AUTOCAPTURE_LAST_EPOCH = AUTOCAPTURE_PREFIX + "last_epoch"
+
 # Label keys (reference pkg/utils/metric_names.go label constants).
 L_DIRECTION = "direction"
 L_REASON = "reason"
@@ -218,3 +248,5 @@ L_TENANT = "tenant"
 L_KEY = "key"
 L_NODE = "node"
 L_SERVICE = "service"
+L_RING = "ring"
+L_STATUS = "status"

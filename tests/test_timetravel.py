@@ -1,0 +1,373 @@
+"""Time-travel tier: snapshot ring, range fold algebra, query API.
+
+The range fold is only a valid time-travel operator if folding ring
+slots is associative, commutative, and has the zero slot as identity —
+then a query over [t0, t1) equals the sketch the engine WOULD have
+built over one long window, regardless of slot grouping or order
+(mirrors the fleet merge-algebra tests in test_fleet.py, with TIME as
+the merge axis instead of nodes).
+
+The query API's contract is latency, not freshness: concurrent scrape
+threads must never queue behind a fold (single-flight + TTL cache +
+serve-stale under SHEDDING), so p99 stays bounded while the ring's
+live edge churns.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from retina_tpu.config import Config
+from retina_tpu.events.synthetic import TrafficGen, preset_params
+from retina_tpu.fleet.dryrun import (
+    INV_SEEDS, _invertible_arrays, _sketch_arrays,
+)
+from retina_tpu.runtime.overload import NOMINAL, SHEDDING
+from retina_tpu.timetravel.fold import (
+    RangeFold, range_cardinality, range_decode, range_entropy,
+    range_extract, range_topk,
+)
+from retina_tpu.timetravel.query import QueryService
+from retina_tpu.timetravel.ring import SnapshotRing
+
+FOLD = RangeFold()
+
+
+def _slot(rng, n_keys: int = 32, heavy=None):
+    """One ring slot: the sketch catalog + invertible regions from
+    random keys (optionally with planted heavy keys)."""
+    keys = rng.integers(0, 2**32, size=(n_keys, 4), dtype=np.uint32)
+    w = rng.integers(1, 20, n_keys).astype(np.int64)
+    if heavy is not None:
+        keys = np.concatenate([keys, heavy.astype(np.uint32)])
+        w = np.concatenate(
+            [w, np.full(len(heavy), 5000, np.int64)]
+        )
+    arrays = _sketch_arrays(keys, w.astype(np.float64))
+    arrays.update(_invertible_arrays(keys, w, np.zeros(len(w), bool)))
+    return arrays
+
+
+def _zero_slot(ref):
+    return {k: np.zeros_like(v) for k, v in ref.items()}
+
+
+def _fold(slots):
+    return FOLD.fold(slots, INV_SEEDS)
+
+
+# Family id -> the merged arrays that must match bitwise.
+_FAMILIES = {
+    "cms": ["flow_cms", "svc_cms", "dns_cms"],
+    "topk": ["flow_keys", "flow_counts"],
+    "hll": ["hll_flows", "hll_src_per_pod"],
+    "entropy": ["entropy"],
+    "invertible": ["inv_flow_planes", "inv_flow_weights",
+                   "inv_hi_planes", "inv_hi_weights"],
+    "totals": ["totals"],
+}
+
+
+def _eq(a, b, names):
+    for n in names:
+        np.testing.assert_array_equal(a[n], b[n], err_msg=n)
+
+
+@pytest.mark.parametrize(
+    "fam", list(_FAMILIES), ids=list(_FAMILIES)
+)
+def test_fold_commutative(fam):
+    rng = np.random.default_rng(1)
+    a, b = _slot(rng), _slot(rng)
+    _eq(_fold([a, b]), _fold([b, a]), _FAMILIES[fam])
+
+
+@pytest.mark.parametrize(
+    "fam", list(_FAMILIES), ids=list(_FAMILIES)
+)
+def test_fold_associative(fam):
+    """fold([a,b,c]) == fold([fold([a,b]), c]): a folded snapshot is
+    itself a valid ring slot, so any grouping of a span gives the same
+    answer (the incremental-rollup property)."""
+    rng = np.random.default_rng(2)
+    a, b, c = _slot(rng), _slot(rng), _slot(rng)
+    _eq(_fold([a, b, c]), _fold([_fold([a, b]), c]), _FAMILIES[fam])
+
+
+@pytest.mark.parametrize(
+    "fam", list(_FAMILIES), ids=list(_FAMILIES)
+)
+def test_fold_identity_on_zero_slot(fam):
+    """Folding in an idle (all-zero) window changes nothing."""
+    rng = np.random.default_rng(3)
+    a, b = _slot(rng), _slot(rng)
+    ref = _fold([a, b])
+    _eq(_fold([a, b, _zero_slot(a)]), ref, _FAMILIES[fam])
+
+
+def test_fold_equals_one_big_window():
+    """The north-star semantics: folding 3 window slots == building one
+    sketch over the concatenated stream (exact for the sum/max arrays)."""
+    rng = np.random.default_rng(4)
+    parts = [
+        (rng.integers(0, 2**32, size=(24, 4), dtype=np.uint32),
+         rng.integers(1, 20, 24).astype(np.int64))
+        for _ in range(3)
+    ]
+    slots = []
+    for keys, w in parts:
+        s = _sketch_arrays(keys, w.astype(np.float64))
+        s.update(_invertible_arrays(keys, w, np.zeros(len(w), bool)))
+        slots.append(s)
+    all_keys = np.concatenate([k for k, _ in parts])
+    all_w = np.concatenate([w for _, w in parts])
+    big = _sketch_arrays(all_keys, all_w.astype(np.float64))
+    big.update(
+        _invertible_arrays(all_keys, all_w, np.zeros(len(all_w), bool))
+    )
+    merged = _fold(slots)
+    for name in ("flow_cms", "entropy", "hll_flows",
+                 "inv_flow_planes", "totals"):
+        np.testing.assert_array_equal(merged[name], big[name],
+                                      err_msg=name)
+
+
+def test_fold_decode_recovers_heavy_keys():
+    """Keys too light per-window decode once the span is folded —
+    and heavy keys planted across windows come back exactly."""
+    rng = np.random.default_rng(5)
+    heavy = rng.integers(0, 2**32, size=(8, 4), dtype=np.uint32)
+    slots = [_slot(rng, heavy=heavy) for _ in range(3)]
+    merged = _fold(slots)
+    dec = range_decode(merged, INV_SEEDS)
+    assert dec is not None
+    got = {tuple(int(x) for x in row) for row in dec["keys"]}
+    want = {tuple(int(x) for x in row) for row in heavy}
+    assert want <= got
+    # Attribution: every planted src ip appears in the source rollup.
+    srcs = set(int(s) for s in dec["sources"][0])
+    assert {int(k[0]) for k in heavy} <= srcs
+
+
+def test_fold_extract_matches_eager_queries():
+    """The compiled extraction program returns the same answers as the
+    eager per-sketch path (cardinality/entropy/top-k counts)."""
+    rng = np.random.default_rng(6)
+    heavy = rng.integers(0, 2**32, size=(4, 4), dtype=np.uint32)
+    merged = _fold([_slot(rng, heavy=heavy) for _ in range(2)])
+    ex = range_extract(merged, INV_SEEDS)
+    assert ex["cardinality"] == pytest.approx(
+        range_cardinality(merged, INV_SEEDS)
+    )
+    assert ex["entropy_bits"] == pytest.approx(
+        range_entropy(merged, INV_SEEDS)
+    )
+    # k past every occupied slot: boundary ties would otherwise admit
+    # different (equally-correct) members from the two paths.
+    fast_k, fast_c = range_topk(
+        merged, INV_SEEDS, k=4096, est=ex["flow_est"]
+    )
+    slow_k, slow_c = range_topk(merged, INV_SEEDS, k=4096)
+    np.testing.assert_array_equal(fast_c, slow_c)
+    # Ties among equal counts may order differently between the two
+    # paths; the (key, count) sets must be identical.
+    fast = {(tuple(map(int, k)), int(c)) for k, c in zip(fast_k, fast_c)}
+    slow = {(tuple(map(int, k)), int(c)) for k, c in zip(slow_k, slow_c)}
+    assert fast == slow
+
+
+def test_fold_empty_selection_raises():
+    with pytest.raises(ValueError):
+        _fold([])
+
+
+# -- ring --------------------------------------------------------------
+
+def _tiny_arrays(epoch: int):
+    return {"x": np.full((4,), epoch, np.uint32)}
+
+
+def test_ring_wraparound_evicts_oldest():
+    ring = SnapshotRing(4, name="t-wrap")
+    for e in range(7):
+        ring.append_host(e, _tiny_arrays(e), 1.0, {"flow": 1})
+    assert len(ring) == 4
+    assert ring.span() == (3, 6)
+    assert ring.evicted == 3
+    assert ring.appended == 7
+    assert [s[0] for s in ring.select(0, 100)] == [3, 4, 5, 6]
+    # Range selection honors [e0, e1) and ignores evicted epochs.
+    assert [s[0] for s in ring.select(2, 5)] == [3, 4]
+    assert ring.select(0, 3) == []
+
+
+def test_ring_offer_worker_readback():
+    ring = SnapshotRing(8, name="t-worker")
+    ring.start()
+    try:
+        assert ring.offer(7, _tiny_arrays(7), 1.0, {"flow": 1})
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and len(ring) == 0:
+            time.sleep(0.01)
+        assert ring.span() == (7, 7)
+        np.testing.assert_array_equal(
+            ring.select(7, 8)[0][1]["x"], _tiny_arrays(7)["x"]
+        )
+    finally:
+        ring.stop()
+    # Stopped ring refuses work instead of queueing it forever.
+    assert not ring.offer(8, _tiny_arrays(8), 1.0, {"flow": 1})
+
+
+def test_ring_offer_never_blocks_when_full():
+    ring = SnapshotRing(8, name="t-full", queue_size=2)  # worker not started
+    assert ring.offer(0, _tiny_arrays(0), 1.0, {})
+    assert ring.offer(1, _tiny_arrays(1), 1.0, {})
+    t0 = time.monotonic()
+    assert not ring.offer(2, _tiny_arrays(2), 1.0, {})
+    assert time.monotonic() - t0 < 0.5  # dropped, not blocked
+
+
+# -- query API ---------------------------------------------------------
+
+class _Ov:
+    state = NOMINAL
+
+
+def _service(n_windows=5, heavy=None):
+    cfg = Config(timetravel_enabled=True, timetravel_ring_windows=16,
+                 timetravel_query_cache_ttl_s=0.2)
+    ov = _Ov()
+    ring = SnapshotRing(16, name="engine")
+    rng = np.random.default_rng(7)
+    for e in range(n_windows):
+        ring.append_host(100 + e, _slot(rng, heavy=heavy), 1.0,
+                         INV_SEEDS)
+    qs = QueryService(cfg, overload=ov)
+    qs.add_ring(ring)
+    return qs, ring, ov
+
+
+def test_query_handle_basics():
+    heavy = np.asarray([[0x0A0000AA, 0x0A0000BB, 80, 6]], np.uint32)
+    qs, ring, _ = _service(heavy=heavy)
+    import json
+
+    code, body, ctype = qs.handle({"t0": ["101"], "t1": ["104"]})
+    assert code == 200 and ctype == "application/json"
+    doc = json.loads(body)
+    assert doc["windows"] == 3 and doc["epochs"] == [101, 102, 103]
+    assert doc["cardinality"] > 0
+    assert set(doc["entropy_bits"]) == {"src_ip", "dst_ip", "dst_port"}
+    assert doc["topk"]["keys"], "planted heavy key must surface"
+    assert doc["decode"]["n_keys"] >= 1
+    # last=N addresses the newest windows without knowing epochs.
+    code, body, _ = qs.handle({"last": ["2"]})
+    assert code == 200
+    assert json.loads(body)["epochs"] == [103, 104]
+
+
+def test_query_handle_errors():
+    qs, _, _ = _service()
+    import json
+
+    assert qs.handle({})[0] == 400
+    assert qs.handle({"t0": ["5"], "t1": ["5"]})[0] == 400
+    assert qs.handle({"ring": ["nope"], "last": ["1"]})[0] == 404
+    empty = QueryService(Config(timetravel_enabled=True), overload=_Ov())
+    empty.add_ring(SnapshotRing(4, name="engine"))
+    code, body, _ = empty.handle({"last": ["1"]})
+    assert code == 200 and json.loads(body)["empty"]
+
+
+def test_query_p99_bounded_under_concurrent_scrapes_and_shedding():
+    """Scrape storm against the handler while the ring's live edge
+    churns: p99 must stay bounded, no thread may queue behind a fold,
+    and flipping SHEDDING mid-storm must only degrade freshness
+    (stale answers), never availability (only 200/503 allowed)."""
+    qs, ring, ov = _service(n_windows=6)
+    rng = np.random.default_rng(8)
+    extra = [_slot(rng) for _ in range(2)]
+    # Prewarm the fold/extract/decode compiles for the span sizes the
+    # storm uses (the daemon pays these at attach time, not per scrape).
+    for span in (2, 3):
+        assert qs.handle({"last": [str(span)]})[0] == 200
+
+    stop = threading.Event()
+
+    def churn():
+        e = 200
+        while not stop.is_set():
+            ring.append_host(e, extra[e % 2], 1.0, INV_SEEDS)
+            e += 1
+            stop.wait(0.01)
+
+    lats, codes = [], set()
+    lock = threading.Lock()
+
+    def scrape(tid):
+        for j in range(25):
+            if j == 12:
+                ov.state = SHEDDING
+            q = ({"last": ["3"]}, {"last": ["2"]},
+                 {"t0": ["101"], "t1": ["104"]})[(tid + j) % 3]
+            t0 = time.monotonic()
+            code, _, _ = qs.handle(q)
+            dt = time.monotonic() - t0
+            with lock:
+                lats.append(dt)
+                codes.add(code)
+            time.sleep(0.002)
+
+    ct = threading.Thread(target=churn, daemon=True)
+    ct.start()
+    threads = [
+        threading.Thread(target=scrape, args=(t,), daemon=True)
+        for t in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    ct.join(timeout=5.0)
+    ov.state = NOMINAL
+    assert codes <= {200, 503}
+    assert 200 in codes
+    assert float(np.percentile(lats, 99)) < 0.5
+    assert float(np.percentile(lats, 50)) < 0.05
+
+
+def test_query_serves_stale_under_shedding():
+    qs, ring, ov = _service(n_windows=4)
+    import json
+
+    assert qs.handle({"t0": ["100"], "t1": ["102"]})[0] == 200
+    ov.state = SHEDDING
+    try:
+        time.sleep(0.25)  # past the TTL: NOMINAL would refold
+        code, body, _ = qs.handle({"t0": ["100"], "t1": ["102"]})
+        assert code == 200
+        assert json.loads(body)["stale"] is True
+    finally:
+        ov.state = NOMINAL
+
+
+# -- config / generator preset -----------------------------------------
+
+def test_gen_preset_validation_and_params():
+    with pytest.raises(ValueError):
+        Config(gen_preset="nope").validate()
+    Config(gen_preset="zipf").validate()
+    assert preset_params("zipf")["zipf_a"] > preset_params("uniform")["zipf_a"]
+    with pytest.raises(ValueError):
+        preset_params("bogus")
+    gen = TrafficGen(n_flows=64, n_pods=8, **preset_params("zipf"))
+    assert gen.zipf_a == preset_params("zipf")["zipf_a"]
+    # Heavier tail: the top flow takes a larger share than uniform's.
+    uni = TrafficGen(n_flows=64, n_pods=8, **preset_params("uniform"))
+    assert gen.flow_probs[0] > uni.flow_probs[0]

@@ -240,6 +240,7 @@ def merge_recipes() -> list[MergeRecipe]:
         ),
     ]
     recipes.append(_fleet_merge_recipe())
+    recipes.append(_timetravel_fold_recipe())
     return recipes
 
 
@@ -278,6 +279,26 @@ def _fleet_merge_recipe() -> MergeRecipe:
     # strictness comes from the per-op recipes above.
     return MergeRecipe(
         "fleet.merge", "sum+max+join", jaxpr,
+        SUM | MAX | JOIN | STRUCTURAL | STACK_REDUCE,
+    )
+
+
+def _timetravel_stub():
+    from retina_tpu.timetravel.fold import RangeFold
+
+    return RangeFold()
+
+
+def _timetravel_fold_recipe() -> MergeRecipe:
+    """The time-axis fold (timetravel/fold.py) runs the same batched
+    reduction as the fleet merge over stacked RING slots instead of
+    stacked nodes — same algebra obligation, same whitelist."""
+    fold = _timetravel_stub()
+    stacked, names, seeds = _fleet_merge_arrays()
+    fn = fold._fold_fn(3, seeds, names)
+    jaxpr = jax.make_jaxpr(fn)(stacked)
+    return MergeRecipe(
+        "timetravel.range_fold", "sum+max+join", jaxpr,
         SUM | MAX | JOIN | STRUCTURAL | STACK_REDUCE,
     )
 
@@ -728,6 +749,40 @@ def entry_audits() -> list[EntryAudit]:
     fm_low = agg._merge_fn(3, seeds, names).lower(stacked)
     audits.append(_audit("fleet.merge", fm_low, 1, donate=(0,)))
 
+    # -- timetravel range fold ----------------------------------------
+    fold = _timetravel_stub()
+    stacked, names, seeds = _fleet_merge_arrays()
+    tt_low = fold._fold_fn(3, seeds, names).lower(stacked)
+    audits.append(_audit("timetravel.range_fold", tt_low, 1, donate=(0,)))
+
+    # -- timetravel range decode --------------------------------------
+    # Tiny invertible region: width 8, depth 2, 4 key cols -> 160 bit
+    # planes; CMS table at matching width. No donation: the operands
+    # are live ring snapshot state.
+    from retina_tpu.timetravel.fold import _decode_program
+
+    planes = jnp.zeros((2, 8, 160), jnp.uint32)
+    weights = jnp.zeros((2, 8), jnp.uint32)
+    table = jnp.zeros((2, 8), jnp.uint32)
+    td_low = _decode_program(planes.shape, 9, 1).lower(
+        planes, weights, table
+    )
+    audits.append(_audit("timetravel.range_decode", td_low, 3))
+
+    # -- timetravel range extract -------------------------------------
+    # Derived answers over one folded snapshot (shape = stacked[0]).
+    from retina_tpu.timetravel.fold import _extract_program
+
+    stacked, _names, seeds = _fleet_merge_arrays()
+    sub = {
+        k: stacked[k][0]
+        for k in ("flow_cms", "flow_keys", "hll_flows", "entropy")
+    }
+    ex_names = tuple(sorted(sub))
+    ex_shapes = tuple(sub[n].shape for n in ex_names)
+    ex_low = _extract_program(ex_names, ex_shapes, seeds).lower(sub)
+    audits.append(_audit("timetravel.range_extract", ex_low, 1))
+
     return audits
 
 
@@ -871,6 +926,9 @@ RECIPE_COVERAGE = {
     "engine.ingest_known": "audit",
     "engine.desc_table": "audit",
     "fleet.merge": "merge+audit",
+    "timetravel.range_fold": "merge+audit",
+    "timetravel.range_decode": "audit",
+    "timetravel.range_extract": "audit",
 }
 
 

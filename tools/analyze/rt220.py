@@ -194,7 +194,9 @@ def check_program(ctxs: list[FileCtx], rep: Reporter, root: Path) -> None:
                 continue  # prose mention of the prefix itself
             if tok in ("networkobservability_adv",
                        "networkobservability_sketch",
-                       "networkobservability_fleet"):
+                       "networkobservability_fleet",
+                       "networkobservability_tpu_timetravel",
+                       "networkobservability_tpu_autocapture"):
                 continue  # prose mention of a family prefix
             if tok not in doc_ok:
                 rep.add(doc_ctx, i, "RT223",
