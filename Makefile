@@ -2,7 +2,7 @@
 # (.github/workflows/); the driver runs bench.py directly.
 
 .PHONY: test native bench bench-smoke soak distributed chaos lint \
-	analyze-device query-dryrun clean
+	analyze-device query-dryrun trace-dryrun clean
 
 native:
 	$(MAKE) -C retina_tpu/native
@@ -21,6 +21,14 @@ bench-smoke: native
 # -> targeted capture, with the query API under concurrent load.
 query-dryrun: native
 	python bench.py --query-dryrun
+
+# Flight-recorder acceptance: the <3% overhead guard, the debug
+# endpoints, and the fleet dryrun's cross-process span-lineage check
+# (ship span and aggregator merge span share the window-epoch trace
+# ID). See docs/observability.md.
+trace-dryrun: native
+	python -m pytest tests/test_obs.py \
+	    tests/test_chaos.py::test_fleet_node_dropout_rollup_continues -q
 
 # 5-minute paced soak with rate/loss/RSS/scrape budgets.
 soak: native

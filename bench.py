@@ -598,6 +598,7 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     # done alone would burn the full 600s cap before measuring a system
     # that already knows some keys will cold-compile mid-window.
     bucket_warm_s = None
+    warm_incomplete = False
     while time.monotonic() - t_warm < 600:
         if eng.bucket_warm_failed.is_set():
             log("e2e: WARNING bucket grid warm FAILED "
@@ -611,6 +612,13 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
             break
         time.sleep(0.5)
     else:
+        # Deadline hit with the warm still running: record how long it
+        # had been going when measurement started (a null here used to
+        # erase the fact that the warm consumed the whole budget —
+        # BENCH diag satellite, PR 13) and flag the measurement window
+        # as warm-contaminated.
+        bucket_warm_s = time.monotonic() - t_warm
+        warm_incomplete = True
         log("e2e: WARNING bucket grid warm not done after 600s; "
             "measuring anyway")
     time.sleep(warmup)
@@ -801,6 +809,20 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     from retina_tpu.parallel.telemetry import aot_disk_cache_stats
 
     aot = aot_disk_cache_stats()
+    # Critical-path report (obs/recorder.py): per-stage span p50/p99
+    # over the run's flight-recorder rings — which pipeline stage owns
+    # a slow window's wall clock (docs/observability.md).
+    from retina_tpu.obs.recorder import get_recorder
+
+    stage_breakdown = get_recorder().stage_report()
+    try:
+        log("e2e: stage breakdown " + " ".join(
+            f"{s}[n={v['count']} p50={v['p50_s'] * 1e3:.2f}ms "
+            f"p99={v['p99_s'] * 1e3:.2f}ms]"
+            for s, v in stage_breakdown.items()
+        ))
+    except Exception:
+        pass
     try:
         xf_s = m.transfer_seconds._sum.get()
         xf_n = sum(b.get() for b in m.transfer_seconds._buckets)
@@ -920,7 +942,14 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
         "bucket_warm_s": (
             None if bucket_warm_s is None else round(bucket_warm_s, 1)
         ),
+        # True when the 600s deadline expired with the warm still
+        # running: bucket_warm_s is then elapsed-at-measure-start, not
+        # time-to-residency, and the windows measured a warming system.
+        "warm_incomplete": warm_incomplete,
         "bucket_warm_failed": warm_failed,
+        # Flight-recorder critical path: per-stage span count/p50/p99
+        # seconds over the run (obs/recorder.py stage_report).
+        "stage_breakdown": stage_breakdown,
         # Sharded-feed backpressure accounting (engine.feed_stats):
         # per-worker quantum fill and handoff wait, plus blocks dropped
         # because every worker's staging was saturated.
@@ -1015,6 +1044,10 @@ def main() -> None:
                     help="multi-agent fleet rollup dryrun: simulated "
                          "node agents ship sketch snapshots to one "
                          "aggregator; one is killed mid-run")
+    ap.add_argument("--trace", metavar="PATH", default=None,
+                    help="write the flight recorder's Chrome trace-"
+                         "event JSON (Perfetto-loadable) here after "
+                         "the run")
     ap.add_argument("--fleet-agents", type=int, default=8,
                     help="number of simulated node agents for "
                          "--fleet-dryrun (default 8; the slow-tier "
@@ -1192,6 +1225,18 @@ def main() -> None:
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}".splitlines()[0][:400],
         }
+    if args.trace:
+        # Trace artifact: every span the in-process recorder retained
+        # (the e2e agent runs in THIS process; the device phase child
+        # keeps its own rings and is not included).
+        try:
+            from retina_tpu.obs.recorder import get_recorder
+
+            with open(args.trace, "w") as f:
+                json.dump(get_recorder().chrome_trace(), f)
+            log(f"trace artifact written to {args.trace}")
+        except Exception:  # noqa: BLE001 — artifact is best-effort, never the exit code
+            log("trace artifact FAILED:\n" + traceback.format_exc())
     print(json.dumps(out), flush=True)
     # Skip interpreter teardown on BOTH paths: daemon threads (device
     # proxy, watchers) may sit inside runtime calls, and tearing the

@@ -376,6 +376,25 @@ class Config:
     # Artifact sink directory (capture host_path output).
     autocapture_output_dir: str = "/tmp/retina-autocapture"
 
+    # --- flight recorder + on-demand profiling (obs/) ---
+    # Always-on span recorder over every pipeline stage
+    # (docs/observability.md). Off only for A/B overhead measurement —
+    # the recorder is the instrument every perf PR reads.
+    trace_enabled: bool = True
+    # Record 1 span in this many per thread (hot-path sampling gate).
+    # Spans are per-flush/per-window cadence, so 1 (record everything)
+    # is affordable; raise on very hot deployments.
+    trace_sample_every: int = 1
+    # Per-thread span ring capacity (preallocated slots).
+    trace_ring_spans: int = 4096
+    # POST /debug/profile: jax.profiler session + all-thread stack
+    # dump artifacts land under this dir, newest profile_max_artifacts
+    # session dirs kept.
+    profile_artifact_dir: str = "/tmp/retina-profile"
+    profile_max_seconds: float = 10.0  # per-session trace length cap
+    profile_cooldown_s: float = 30.0  # min spacing between sessions
+    profile_max_artifacts: int = 4
+
     # --- pipeline shapes (jit keys; see models/pipeline.py) ---
     n_pods: int = 1 << 12
     cms_width: int = 1 << 15
@@ -534,6 +553,22 @@ class Config:
             raise ValueError(
                 f"invertible_min_weight must be >= 0, "
                 f"got {self.invertible_min_weight}"
+            )
+        for f in ("trace_sample_every", "trace_ring_spans",
+                  "profile_max_artifacts"):
+            if getattr(self, f) < 1:
+                raise ValueError(
+                    f"{f} must be >= 1, got {getattr(self, f)}"
+                )
+        if self.profile_max_seconds <= 0:
+            raise ValueError(
+                f"profile_max_seconds must be > 0, "
+                f"got {self.profile_max_seconds}"
+            )
+        if self.profile_cooldown_s < 0:
+            raise ValueError(
+                f"profile_cooldown_s must be >= 0, "
+                f"got {self.profile_cooldown_s}"
             )
         for f in ("overload_priority_ip_mask", "overload_priority_ip_match"):
             v = getattr(self, f)

@@ -296,6 +296,15 @@ class Daemon:
             # agent mux; registration is a dict insert, safe while the
             # server serves.
             self.query_service.attach(self.cm.server)
+        if self.cm.server is not None:
+            # Flight-recorder debug API (obs/debug.py): GET /debug/trace
+            # + POST /debug/profile, same attach shape as the query
+            # service; SHEDDING-aware via the engine's controller.
+            from retina_tpu.obs.debug import DebugObservability
+
+            DebugObservability(
+                self.cfg, overload=self.cm.engine._overload
+            ).attach(self.cm.server)
         if self.autocapture is not None:
             self.autocapture.start()
         if self.monitoragent is not None:

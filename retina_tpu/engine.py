@@ -39,6 +39,7 @@ from retina_tpu.log import logger, rate_limited
 from retina_tpu.metrics import get_metrics
 from retina_tpu.models.identity import HostIdentityTable, IdentityMap
 from retina_tpu.models.pipeline import PipelineConfig
+from retina_tpu.obs.recorder import initialize_recorder
 from retina_tpu.parallel.combine import combine_blocks
 from retina_tpu.parallel.feed import (
     FeedWorkerPool, TransferMux, TransferQueue,
@@ -54,6 +55,7 @@ from retina_tpu.runtime.overload import OverloadController
 from retina_tpu.runtime.supervisor import (
     Heartbeat, Supervisor, policy_from_config,
 )
+from retina_tpu.utils import metric_names as mnames
 from retina_tpu.utils.device_proxy import (
     fence, fetch_on_device, run_on_device, submit_on_device,
 )
@@ -385,6 +387,38 @@ class SketchEngine:
             os.path.join(cfg.snapshot_dir, "sketch_state.npz")
             if cfg.snapshot_dir else None
         )
+        # Flight recorder (obs/recorder.py): rebuild the process
+        # singleton from config so every span site — here, the feed
+        # workers, the fleet shipper/aggregator — shares the same rings
+        # and sampling policy. Sites outside the engine fetch it via
+        # get_recorder() per call, so the rebuild is visible everywhere.
+        self._recorder = initialize_recorder(
+            capacity=cfg.trace_ring_spans,
+            sample_every=cfg.trace_sample_every,
+            enabled=cfg.trace_enabled,
+        )
+        self._start_monotonic = time.monotonic()
+        self._publish_build_info()
+
+    def _publish_build_info(self) -> None:
+        """One-shot build/runtime identity gauge (value always 1; the
+        labels are the payload) plus the uptime baseline — the classic
+        *_build_info join-series pattern."""
+        from retina_tpu.utils import buildinfo
+
+        m = get_metrics()
+        try:
+            backend = jax.default_backend()
+        except Exception:  # noqa: RT101 — identity gauge must never block engine boot
+            backend = "unknown"
+        m.build_info.labels(
+            version=buildinfo.VERSION,
+            jax=jax.__version__,
+            backend=backend,
+            devices=str(self.n_devices),
+            config=self._aot_sig,
+        ).set(1)
+        m.uptime_seconds.set(0.0)
 
     # -- supervision helpers ------------------------------------------
     def _register_hb(  # runs-on: feed-worker*, engine-recover, window-harvest
@@ -986,12 +1020,12 @@ class SketchEngine:
                 self.cfg.aot_cache_dir, self.mesh, tag,
                 self._aot_sig, key,
             )
-            ex = aot_disk_load(path)
+            ex = aot_disk_load(path, tag=tag)
             if ex is not None:
                 return ex
         ex = lower().compile()
         if path is not None:
-            aot_disk_save(path, ex)
+            aot_disk_save(path, ex, tag=tag)
         return ex
 
     @device_entry("engine.ingest", kind="jit")
@@ -1613,6 +1647,13 @@ class SketchEngine:
                 t_end = time.perf_counter()
                 m.transfer_seconds.observe(t0 - t_x0)
                 m.device_step_seconds.observe(t_end - t0)
+                tid = fleet_epoch(self.cfg.window_seconds)
+                self._recorder.record(
+                    mnames.STAGE_TRANSFER, t_x0, tid, t1=t0
+                )
+                self._recorder.record(
+                    mnames.STAGE_DEVICE_STEP, t0, tid, t1=t_end
+                )
                 # Overload signal: EWMA of transfer+step wall time
                 # (proxy thread only — no lock needed).
                 self._dispatch_lat_ewma = (
@@ -1655,6 +1696,10 @@ class SketchEngine:
                 self._inflight.release()
 
         t_d1 = time.monotonic()
+        self._recorder.record(
+            mnames.STAGE_WIRE_BUILD, t_d0,
+            fleet_epoch(self.cfg.window_seconds), t1=t_d1,
+        )
         self._inflight.acquire()
         with self._busy_lock:
             self._inflight_busy += 1
@@ -1727,6 +1772,7 @@ class SketchEngine:
         m = get_metrics()
         if sb.lost and record_metrics:
             m.lost_events.labels(stage="partition", plugin="engine").inc(sb.lost)
+        t_w0 = time.monotonic()
         if self.cfg.transfer_packed:
             from retina_tpu.parallel.wire import pack_records
 
@@ -1751,6 +1797,12 @@ class SketchEngine:
         n_valid_total = int(sb.n_valid.sum())
         n_events = int(sb.events)
         samp_k = int(sb.sample_k)
+        if record_metrics:
+            self._recorder.record(
+                mnames.STAGE_WIRE_BUILD, t_w0,
+                fleet_epoch(self.cfg.window_seconds),
+                t1=time.monotonic(),
+            )
 
         def xfer_and_step():
             faults.inject("transfer")
@@ -1790,6 +1842,13 @@ class SketchEngine:
                 t_end = time.perf_counter()
                 m.transfer_seconds.observe(t0 - t_x0)
                 m.device_step_seconds.observe(t_end - t0)
+                tid = fleet_epoch(self.cfg.window_seconds)
+                self._recorder.record(
+                    mnames.STAGE_TRANSFER, t_x0, tid, t1=t0
+                )
+                self._recorder.record(
+                    mnames.STAGE_DEVICE_STEP, t0, tid, t1=t_end
+                )
                 # Overload signal: EWMA of transfer+step wall time
                 # (proxy thread only — no lock needed).
                 self._dispatch_lat_ewma = (
@@ -1870,6 +1929,9 @@ class SketchEngine:
             win_host["overload"] = meta
         self.last_window = win_host
         m = get_metrics()
+        # Uptime rides the window-publish cadence (>= one update per
+        # window_seconds) — cheap, and always fresh at scrape time.
+        m.uptime_seconds.set(time.monotonic() - self._start_monotonic)
         dims = ["src_ip", "dst_ip", "dst_port"]
         for i, dim in enumerate(dims):
             m.entropy_bits.labels(dimension=dim).set(
@@ -1981,12 +2043,21 @@ class SketchEngine:
                     # JAX call must ride the proxy thread (tunnel
                     # backend wedges under concurrent runtime access),
                     # but the queue-wait happens here, off-proxy.
+                    tid = fleet_epoch(self.cfg.window_seconds)
+                    t_h0 = time.perf_counter()
                     host = fetch_on_device(stacked)
+                    self._recorder.record(
+                        mnames.STAGE_HARVEST, t_h0, tid
+                    )
+                    t_p0 = time.perf_counter()
                     self._publish_window({
                         "entropy_bits": host[0],
                         "anomaly": host[1],
                         "zscore": host[2],
                     }, meta)
+                    self._recorder.record(
+                        mnames.STAGE_PUBLISH, t_p0, tid
+                    )
                     inv_dec = meta.pop("inv_decode", None)
                     if inv_dec is not None:
                         self._harvest_invertible(inv_dec)
@@ -2127,6 +2198,7 @@ class SketchEngine:
         meta["events"] = ingested - self._closed_events_in
 
         def close():
+            t_c0 = time.perf_counter()
             self._device_consts()
             with self._state_lock:
                 if (self._fleet_shipper is not None
@@ -2173,6 +2245,11 @@ class SketchEngine:
                 self.state, win = self.sharded.end_window(
                     self.state, self._zthresh
                 )
+            self._recorder.record(
+                mnames.STAGE_WINDOW_CLOSE, t_c0,
+                fleet_epoch(self.cfg.window_seconds),
+                t1=time.perf_counter(),
+            )
             return self._win_stack(win), inv
 
         stacked, inv_dec = run_on_device(close)
@@ -2311,6 +2388,7 @@ class SketchEngine:
         coal_per_dev = self.cfg.batch_capacity * max(
             1, self.cfg.feed_coalesce_windows
         )
+        t_cb0 = self._recorder.begin()
         if self.cfg.host_combine:
             all_rec = combine_blocks(blocks)
             get_metrics().combine_ratio.set(
@@ -2320,6 +2398,10 @@ class SketchEngine:
             all_rec = blocks[0]
         else:
             all_rec = np.concatenate(blocks, axis=0)
+        self._recorder.record(
+            mnames.STAGE_COMBINE, t_cb0,
+            fleet_epoch(self.cfg.window_seconds),
+        )
         # Overload sampling sits POST-combine / PRE-partition: a row's
         # packet weight is final here, so the device step can recompute
         # the same exemption predicate over the same rows and rescale
@@ -2572,6 +2654,11 @@ class SketchEngine:
                 self._overload.tick()
                 blocks = self.sink.drain(max_blocks=64)
                 shed_dns = self._overload.shed_active("dns")
+                # Span covers the emit handoff: generator blocks leave
+                # the sink and are dealt into the feed (observers +
+                # staging) — begin() only when there IS a drain, so an
+                # idle spin never burns sampling ticks.
+                t_g0 = self._recorder.begin() if blocks else 0.0
                 for rec, plugin in blocks:
                     for obs, oname in self._observers:
                         if shed_dns and oname == "dns":
@@ -2607,6 +2694,11 @@ class SketchEngine:
                     # quantum plus a block's worth of overshoot.
                     if n_pending >= quantum:
                         flush()
+                if blocks:
+                    self._recorder.record(
+                        mnames.STAGE_GENERATOR_EMIT, t_g0,
+                        fleet_epoch(self.cfg.window_seconds),
+                    )
                 now = time.monotonic()
                 if n_pending and now - last_flush >= self.cfg.flush_interval_s:
                     # Interval flushes serve LATENCY and only make sense

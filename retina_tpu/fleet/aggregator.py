@@ -43,12 +43,14 @@ from retina_tpu.fleet.codec import (
 )
 from retina_tpu.log import logger, rate_limited
 from retina_tpu.metrics import get_metrics
+from retina_tpu.obs.recorder import get_recorder
 from retina_tpu.ops.countmin import CountMinSketch
 from retina_tpu.ops.entropy import EntropyWindow
 from retina_tpu.ops.hyperloglog import HyperLogLog
 from retina_tpu.ops.invertible import InvertibleSketch, decode_verified
 from retina_tpu.ops.topk import TopKTable
 from retina_tpu.pubsub import get_pubsub
+from retina_tpu.utils import metric_names as mn
 
 ENTROPY_DIMS = ("src_ip", "dst_ip", "dst_port")
 _HH_FAMILIES = ("flow", "svc", "dns")
@@ -288,9 +290,20 @@ class FleetAggregator:
     ) -> None:
         t0 = time.monotonic()
         m = get_metrics()
+        rec = get_recorder()
+        span_t0 = rec.begin()
         snaps = sorted(bucket.snaps.values(), key=lambda s: s.node)
         if not snaps:
             return
+        # Cross-process lineage: the shipped trace context carries the
+        # window-epoch trace ID from the node's close path; frames from
+        # trace-less (older) nodes fall back to the epoch itself, which
+        # is the same value by construction.
+        trace_id = next(
+            (int(s.trace["tid"]) for s in snaps
+             if s.trace is not None and "tid" in s.trace),
+            int(epoch),
+        )
         with self._lock:
             self._watermark = max(self._watermark, epoch)
         names = sorted(
@@ -324,6 +337,7 @@ class FleetAggregator:
         rollup["straggled"] = straggled
         rollup["merge_seconds"] = time.monotonic() - t0
         self._publish(rollup)
+        rec.record(mn.STAGE_AGG_MERGE, span_t0, trace_id)
         m.fleet_windows_merged.inc()
         if straggled:
             m.fleet_windows_stragglers.inc()

@@ -116,6 +116,13 @@ class FleetSnapshot:
     window_s: float
     seeds: dict[str, int]  # sketch hash seeds (merge identity)
     arrays: dict[str, np.ndarray]
+    # Optional trace context (obs/recorder.py): the window-epoch trace
+    # ID plus origin metadata, so the aggregator's merge span joins the
+    # shipping node's span lineage. Absent on frames from older nodes
+    # (and omitted from the wire when None), so the codec stays
+    # compatible in both directions: old decoders ignore the unknown
+    # msgpack key, this decoder tolerates its absence.
+    trace: dict | None = None
 
     def nbytes(self) -> int:
         return sum(int(a.nbytes) for a in self.arrays.values())
@@ -141,7 +148,7 @@ def encode_snapshot(snap: FleetSnapshot) -> bytes:
             "n": name, "d": wire, "t": target, "s": list(arr.shape),
         })
         chunks.append(wired.tobytes())
-    header = msgpack.packb({
+    hdr: dict = {
         "v": VERSION,
         "node": snap.node,
         "tenant": snap.tenant,
@@ -151,7 +158,12 @@ def encode_snapshot(snap: FleetSnapshot) -> bytes:
         "win_s": float(snap.window_s),
         "seeds": {k: int(v) for k, v in snap.seeds.items()},
         "arrays": directory,
-    }, use_bin_type=True)
+    }
+    if snap.trace is not None:
+        # Optional trace context: omitted entirely when unset so frames
+        # from trace-less encoders stay byte-identical to v1-as-shipped.
+        hdr["trace"] = snap.trace
+    header = msgpack.packb(hdr, use_bin_type=True)
     return b"".join(
         [MAGIC, bytes([VERSION]), struct.pack("<I", len(header)), header]
         + chunks
@@ -204,6 +216,8 @@ def decode_snapshot(frame: bytes) -> FleetSnapshot:
             window_s=float(hdr["win_s"]),
             seeds={str(k): int(v) for k, v in hdr["seeds"].items()},
             arrays=arrays,
+            trace=(dict(hdr["trace"])
+                   if isinstance(hdr.get("trace"), dict) else None),
         )
     except (KeyError, TypeError, ValueError) as e:
         raise FleetDecodeError(f"bad header field: {e}") from e

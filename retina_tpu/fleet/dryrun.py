@@ -37,6 +37,8 @@ import numpy as np
 
 from retina_tpu.config import Config
 from retina_tpu.fleet.aggregator import FleetAggregator
+from retina_tpu.obs.recorder import get_recorder
+from retina_tpu.utils import metric_names as mn
 from retina_tpu.fleet.shipper import SnapshotShipper
 from retina_tpu.ops.countmin import CountMinSketch
 from retina_tpu.ops.entropy import EntropyWindow
@@ -269,6 +271,21 @@ def run_dryrun(
     post_kill = [
         r for r in rollups if r["epoch"] >= kill_after
     ]
+    # Span lineage across the wire: the shipper's send span and the
+    # aggregator's merge span for the same window must share the
+    # window-epoch trace ID (shipped in the RFLT trace-context header),
+    # so a flamegraph of one epoch is followable node -> aggregator.
+    spans = get_recorder().spans()
+    ship_tids = {
+        s["trace_id"] for s in spans if s["stage"] == mn.STAGE_SHIP_SEND
+    }
+    merge_tids = {
+        s["trace_id"] for s in spans if s["stage"] == mn.STAGE_AGG_MERGE
+    }
+    merged_epochs = {r["epoch"] for r in rollups}
+    lineage_ok = bool(merged_epochs) and merged_epochs <= (
+        ship_tids & merge_tids
+    )
     res = {
         "nodes": nodes,
         "epochs": epochs,
@@ -290,12 +307,14 @@ def run_dryrun(
         "tenant_series_max_observed": series_obs,
         "epoch_history_bound": int(base.fleet_epoch_history),
         "open_buckets_max": agg.open_buckets_max,
+        "trace_lineage_ok": lineage_ok,
         "ok": bool(
             agg.epochs_merged >= epochs
             and recall >= 0.95
             and series_obs <= bound
             and tenants_seen <= base.fleet_max_tenants
             and agg.open_buckets_max <= base.fleet_epoch_history
+            and lineage_ok
         ),
     }
     log(

@@ -32,8 +32,10 @@ import numpy as np
 from retina_tpu.fleet.codec import FLEET_TOPIC, FleetSnapshot, encode_snapshot
 from retina_tpu.log import logger, rate_limited
 from retina_tpu.metrics import get_metrics
+from retina_tpu.obs.recorder import get_recorder
 from retina_tpu.pubsub import get_pubsub
 from retina_tpu.runtime.overload import SHEDDING
+from retina_tpu.utils import metric_names as mn
 from retina_tpu.utils.device_proxy import fetch_on_device
 
 
@@ -151,12 +153,15 @@ class SnapshotShipper:
         window_s: float,
         seeds: dict[str, int],
     ) -> None:
+        rec = get_recorder()
+        t0 = rec.begin()
         host: dict[str, np.ndarray] = {}
         for name, arr in arrays.items():
             if isinstance(arr, np.ndarray):
                 host[name] = arr
             else:
                 host[name] = fetch_on_device(arr)
+        rec.record(mn.STAGE_SHIP_READBACK, t0, int(epoch))
         with self._lock:
             seq = self._seq
             self._seq += 1
@@ -164,9 +169,17 @@ class SnapshotShipper:
             node=self.node, tenant=self.tenant, priority=self.priority,
             epoch=int(epoch), seq=seq, window_s=float(window_s),
             seeds=seeds, arrays=host,
+            # Trace context: the window epoch IS the trace ID; the
+            # aggregator's merge span joins this lineage across the
+            # process boundary (docs/observability.md).
+            trace={"tid": int(epoch), "node": self.node},
         )
+        t0 = rec.begin()
         frame = encode_snapshot(snap)
+        rec.record(mn.STAGE_SHIP_ENCODE, t0, int(epoch))
+        t0 = rec.begin()
         self._send(frame)
+        rec.record(mn.STAGE_SHIP_SEND, t0, int(epoch))
         m = get_metrics()
         m.fleet_snapshots_shipped.inc()
         m.fleet_ship_bytes.inc(len(frame))

@@ -207,6 +207,22 @@ class Metrics:
             mn.AUTOCAPTURE_ARTIFACT_BYTES, []
         )
         self.autocapture_last_epoch = g(mn.AUTOCAPTURE_LAST_EPOCH, [])
+        # Flight recorder (obs/recorder.py): per-stage span latency.
+        # Label space is the FIXED stage registry (mn.STAGES); buckets
+        # span sub-ms host hops to multi-second device round-trips.
+        self.stage_seconds = ex.new_histogram(
+            mn.TPU_STAGE_SECONDS,
+            [mn.L_STAGE],
+            buckets=[1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2,
+                     0.1, 0.3, 1.0, 3.0],
+        )
+        # Build identity + process uptime (set once / ticked by the
+        # engine; docs/observability.md).
+        self.build_info = g(
+            mn.RETINA_BUILD_INFO,
+            ["version", "jax", "backend", "devices", "config"],
+        )
+        self.uptime_seconds = g(mn.TPU_UPTIME_SECONDS, [])
 
 
 _singleton: Metrics | None = None

@@ -1,0 +1,19 @@
+"""Pipeline observability: flight recorder + debug trace/profile API.
+
+The flight recorder (`recorder.py`) is the always-on, bounded-overhead
+span store every pipeline stage reports into; `debug.py` serves it
+(`GET /debug/trace`) and owns the on-demand deep-profiling endpoint
+(`POST /debug/profile`). Design notes: docs/observability.md.
+"""
+
+from retina_tpu.obs.recorder import (
+    FlightRecorder,
+    get_recorder,
+    initialize_recorder,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "get_recorder",
+    "initialize_recorder",
+]

@@ -281,11 +281,19 @@ class FeedWorker(threading.Thread):
         self.events_out += n_raw
         self.first_t = time.monotonic()
         self.fill = n_raw / max(self.pool.quantum, 1)
+        from retina_tpu.obs.recorder import get_recorder
+        from retina_tpu.utils import metric_names as mn
+
+        rec = get_recorder()
+        t0 = rec.begin()
         items = self.pool.build_steps(blocks, n_raw, int(time.time()))
+        rec.record(mn.STAGE_FEED_FILL, t0)
+        t0 = rec.begin()
         for it in items:
             if not self.outq.put(it, alive=self.pool.alive):
                 self.handoff_dropped += 1
                 self.pool.drop(it)
+        rec.record(mn.STAGE_STAGING_HANDOFF, t0)
         self.batches += 1
         self._publish_metrics()
 

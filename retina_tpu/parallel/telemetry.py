@@ -61,20 +61,31 @@ else:  # pragma: no cover - depends on installed jax
 # across every AotProgram instance in the process.
 _AOT_DISK_LOCK = threading.Lock()
 _AOT_DISK_STATS = {"hits": 0, "misses": 0, "errors": 0}
+# Per-program-tag breakdown of the same counters: the BENCH_r06
+# regression (hits=1 misses=26) was invisible in the totals — the
+# per-tag view names exactly which programs keep re-compiling.
+_AOT_TAG_STATS: dict[str, dict[str, int]] = {}
 
 
-def aot_disk_cache_stats() -> dict[str, int]:
+def aot_disk_cache_stats() -> dict[str, Any]:
     """Process-wide disk-cache counters: ``hits`` (deserialized from
     disk, compile skipped), ``misses`` (compiled + persisted),
     ``errors`` (load/save attempts that failed; always fell back to a
-    fresh compile, never fatal)."""
+    fresh compile, never fatal). ``by_tag`` breaks the same counters
+    down per program tag (step, snapshot, ingest buckets, ...)."""
     with _AOT_DISK_LOCK:
-        return dict(_AOT_DISK_STATS)
+        out: dict[str, Any] = dict(_AOT_DISK_STATS)
+        out["by_tag"] = {t: dict(s) for t, s in _AOT_TAG_STATS.items()}
+        return out
 
 
-def _aot_disk_bump(field: str) -> None:
+def _aot_disk_bump(field: str, tag: str = "") -> None:
     with _AOT_DISK_LOCK:
         _AOT_DISK_STATS[field] += 1
+        if tag:
+            _AOT_TAG_STATS.setdefault(
+                tag, {"hits": 0, "misses": 0, "errors": 0}
+            )[field] += 1
 
 
 # -- free-function disk layer -----------------------------------------
@@ -84,12 +95,17 @@ def _aot_disk_bump(field: str) -> None:
 # cache as the step programs for a warm boot to land under 10s.
 
 def aot_disk_path(
-    cache_dir: str, mesh: Mesh, tag: str, config_sig: str, key
+    cache_dir: str, mesh: Mesh | None, tag: str, config_sig: str, key
 ) -> str:
     """Cache-file path for one (program tag, input-signature) pair,
     keyed by jax version + backend topology + config signature so a
-    stale entry can never load into a mismatched process."""
-    devs = mesh.devices.ravel()
+    stale entry can never load into a mismatched process. ``mesh=None``
+    keys on the full default device set — the mesh-less query programs
+    (timetravel/fold.py) compile against it."""
+    devs = (
+        mesh.devices.ravel() if mesh is not None
+        else np.asarray(jax.devices())
+    )
     topo = "{}:{}:{}".format(
         jax.default_backend(), len(devs),
         getattr(devs[0], "device_kind", "?"),
@@ -99,10 +115,11 @@ def aot_disk_path(
     return os.path.join(cache_dir, f"{tag}-{h}.aotx")
 
 
-def aot_disk_load(path: str):
+def aot_disk_load(path: str, tag: str = ""):
     """Deserialize a cached executable, or None (best-effort: stale jax,
     corrupt/truncated file, incompatible executable all fall back to a
-    fresh compile)."""
+    fresh compile). ``tag`` feeds the per-program counters and the
+    hit/miss log line."""
     if not os.path.exists(path):
         return None
     try:
@@ -113,14 +130,16 @@ def aot_disk_load(path: str):
         ex = se.deserialize_and_load(
             payload["exe"], payload["in_tree"], payload["out_tree"]
         )
-        _aot_disk_bump("hits")
+        _aot_disk_bump("hits", tag)
+        if tag:
+            _aot_log().debug("aot disk HIT tag=%s path=%s", tag, path)
         return ex
     except Exception:
-        _aot_disk_bump("errors")
+        _aot_disk_bump("errors", tag)
         return None
 
 
-def aot_disk_save(path: str, ex) -> None:
+def aot_disk_save(path: str, ex, tag: str = "") -> None:
     """Persist a compiled executable (best-effort; never fails the
     caller — persisting is an optimization only)."""
     try:
@@ -136,9 +155,19 @@ def aot_disk_save(path: str, ex) -> None:
                 f,
             )
         os.replace(tmp, path)
-        _aot_disk_bump("misses")
+        _aot_disk_bump("misses", tag)
+        if tag:
+            _aot_log().info(
+                "aot disk MISS tag=%s (compiled + persisted)", tag
+            )
     except Exception:
-        _aot_disk_bump("errors")
+        _aot_disk_bump("errors", tag)
+
+
+def _aot_log():
+    from retina_tpu.log import logger
+
+    return logger("aot.cache")
 
 
 class AotProgram:
@@ -198,10 +227,10 @@ class AotProgram:
         )
 
     def _disk_load(self, path: str):
-        return aot_disk_load(path)
+        return aot_disk_load(path, tag=self._tag)
 
     def _disk_save(self, path: str, ex) -> None:
-        aot_disk_save(path, ex)
+        aot_disk_save(path, ex, tag=self._tag)
 
     def _lower(self, args, key=None):
         if self._cache_dir and key is not None:
@@ -513,7 +542,15 @@ class ShardedTelemetry:
             # but psum/pmax/all_gather outputs are replicated by definition.
             check_vma=False,
         )
-        return jax.jit(fn)
+        # AOT-wrapped like _build_step: the scrape/export programs were
+        # the bulk of the BENCH_r06 hits=1/misses=26 warm regression —
+        # every restart re-lowered them while only the step program hit
+        # disk.
+        return AotProgram(
+            jax.jit(fn), self.mesh, self._sharded_spec, (0,),
+            cache_dir=self._aot_cache_dir, tag="snapshot",
+            config_sig=self._config_sig,
+        )
 
     def snapshot(self, state: PipelineState, now_s) -> dict[str, Any]:
         """Merged scrape-time readout (device dict; np.asarray leaves to read)."""
@@ -575,7 +612,11 @@ class ShardedTelemetry:
             out_specs=P(),  # every output collective-merged => replicated
             check_vma=False,
         )
-        return jax.jit(fn)
+        return AotProgram(
+            jax.jit(fn), self.mesh, self._sharded_spec, (0,),
+            cache_dir=self._aot_cache_dir, tag="fleet_export",
+            config_sig=self._config_sig,
+        )
 
     def fleet_export(self, state: PipelineState) -> dict[str, Any]:
         """Device-merged wire snapshot for the fleet rollup tier
@@ -649,7 +690,11 @@ class ShardedTelemetry:
             out_specs=P(),  # psum-merged inputs => replicated decode
             check_vma=False,
         )
-        return jax.jit(fn)
+        return AotProgram(
+            jax.jit(fn), self.mesh, self._sharded_spec, (0,),
+            cache_dir=self._aot_cache_dir, tag="inv_decode",
+            config_sig=self._config_sig,
+        )
 
     def inv_decode(self, state: PipelineState, min_weight=0) -> dict[str, Any]:
         """Window-close invertible decode (fixed shape, async dispatch
@@ -665,7 +710,10 @@ class ShardedTelemetry:
     # ------------------------------------------------------------------
     @device_entry("sharded.snapshot_flat", kind="jit")
     def _build_snapshot_flat(self, state: PipelineState):
-        base = self._build_snapshot()
+        # Trace through the UNDERLYING jit (an AotProgram cannot run
+        # under eval_shape/jit tracing — its executables take concrete
+        # arrays); the flat program gets its own AOT disk entry below.
+        base = self._build_snapshot()._jitted
         shapes = jax.eval_shape(base, state, np.uint32(0))
         leaves, treedef = jax.tree_util.tree_flatten(shapes)
 
@@ -685,7 +733,12 @@ class ShardedTelemetry:
                 out.append(leaf.reshape(-1))
             return jnp.concatenate(out)
 
-        return jax.jit(flat_fn), leaves, treedef
+        prog = AotProgram(
+            jax.jit(flat_fn), self.mesh, self._sharded_spec, (0,),
+            cache_dir=self._aot_cache_dir, tag="snapshot_flat",
+            config_sig=self._config_sig,
+        )
+        return prog, leaves, treedef
 
     def snapshot_host(self, state: PipelineState, now_s) -> dict[str, Any]:
         """Merged snapshot delivered to HOST memory in ONE device->host

@@ -56,6 +56,12 @@ class Server:
         self._routes: dict[
             str, Callable[[dict], tuple[int, bytes, str]]
         ] = {}
+        # Extension POST routes (obs debug profile API): same contract
+        # as _routes, separate table so a GET on a POST-only path (and
+        # vice versa) is a clean 405, not a silent dispatch.
+        self._post_routes: dict[
+            str, Callable[[dict], tuple[int, bytes, str]]
+        ] = {}
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         # Rendering ~50k pod-level series is Python-heavy (~0.5s at 2k
@@ -163,6 +169,17 @@ class Server:
         runs on handler threads and must bound its own latency."""
         self._routes[path.rstrip("/") or "/"] = fn
 
+    def register_post_route(
+        self,
+        path: str,
+        fn: Callable[[dict], tuple[int, bytes, str]],
+    ) -> None:
+        """Register an extension POST route (same contract as
+        :meth:`register_route`; ``fn`` receives the parsed query-string
+        dict — request bodies are ignored by design, the debug API is
+        parameter-only)."""
+        self._post_routes[path.rstrip("/") or "/"] = fn
+
     @property
     def port(self) -> int:
         """Bound port (useful when constructed with port 0 in tests)."""
@@ -233,6 +250,35 @@ class Server:
                             parse_qs(url.query)
                         )
                         self._send(code, body, ctype)
+                    elif route in srv._post_routes:
+                        self._send(405, b"use POST", "text/plain")
+                    else:
+                        self._send(404, b"not found", "text/plain")
+                except BrokenPipeError:  # noqa: RT101 — client hung up mid-response
+                    pass
+                except Exception:
+                    _log.exception("handler error path=%s", self.path)
+                    try:
+                        self._send(500, b"internal error", "text/plain")
+                    except Exception:  # noqa: RT101 — 500 write raced the hangup; already logged
+                        pass
+
+            def do_POST(self) -> None:  # noqa: N802
+                try:
+                    # Drain (and discard) any body so keep-alive framing
+                    # stays correct; POST routes take query params only.
+                    length = int(self.headers.get("Content-Length") or 0)
+                    if length > 0:
+                        self.rfile.read(min(length, 1 << 20))
+                    url = urlparse(self.path)
+                    route = url.path.rstrip("/") or "/"
+                    if route in srv._post_routes:
+                        code, body, ctype = srv._post_routes[route](
+                            parse_qs(url.query)
+                        )
+                        self._send(code, body, ctype)
+                    elif route in srv._routes:
+                        self._send(405, b"use GET", "text/plain")
                     else:
                         self._send(404, b"not found", "text/plain")
                 except BrokenPipeError:  # noqa: RT101 — client hung up mid-response

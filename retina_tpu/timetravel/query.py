@@ -31,7 +31,7 @@ from retina_tpu.log import logger, rate_limited
 from retina_tpu.metrics import get_metrics
 from retina_tpu.runtime.overload import SHEDDING
 from retina_tpu.timetravel.fold import (
-    RangeFold, range_decode, range_extract, range_topk,
+    RangeFold, range_decode, range_extract, range_topk, set_aot_cache_dir,
 )
 from retina_tpu.timetravel.ring import SnapshotRing
 
@@ -49,6 +49,10 @@ class QueryService:
         self.cfg = cfg
         self.log = logger("timetravel.query")
         self._overload = overload
+        # Query programs share the engine's AOT disk cache — without
+        # this, every restart re-lowers fold/extract/decode from
+        # scratch (the BENCH_r06 hits=1/misses=26 regression).
+        set_aot_cache_dir(getattr(cfg, "aot_cache_dir", ""))
         self.fold = fold or RangeFold()
         self.rings: dict[str, SnapshotRing] = {}
         # (ring, e0, e1, k, fam, appended) -> (monotonic_t, result doc)

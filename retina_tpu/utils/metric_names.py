@@ -222,6 +222,56 @@ AUTOCAPTURE_KEYS = AUTOCAPTURE_PREFIX + "attributed_keys"
 AUTOCAPTURE_ARTIFACT_BYTES = AUTOCAPTURE_PREFIX + "artifact_bytes"
 AUTOCAPTURE_LAST_EPOCH = AUTOCAPTURE_PREFIX + "last_epoch"
 
+# Flight recorder (retina_tpu/obs/): per-window stage-latency
+# breakdown. tpu_stage_seconds{stage} is observed once per SAMPLED span
+# by the recorder; build_info is a constant-1 gauge whose labels
+# identify the running build (version/jax/backend/devices/config
+# signature — the scrape-side answer to "what exactly is running?");
+# uptime_seconds is seconds since engine start.
+TPU_STAGE_SECONDS = PREFIX + "tpu_stage_seconds"
+RETINA_BUILD_INFO = PREFIX + "retina_build_info"
+TPU_UPTIME_SECONDS = PREFIX + "tpu_uptime_seconds"
+
+# Pipeline stage-name registry (the ONLY legal values of the
+# tpu_stage_seconds `stage` label and of every recorder span). The
+# RT226 analyzer machine-checks three-way agreement between these
+# constants, the span names actually emitted through the recorder, and
+# the stage table in docs/observability.md — add the constant, the
+# emission site and the doc row together.
+STAGE_GENERATOR_EMIT = "generator_emit"
+STAGE_COMBINE = "combine"
+STAGE_FEED_FILL = "feed_fill"
+STAGE_STAGING_HANDOFF = "staging_handoff"
+STAGE_WIRE_BUILD = "wire_build"
+STAGE_TRANSFER = "transfer"
+STAGE_DEVICE_STEP = "device_step"
+STAGE_WINDOW_CLOSE = "window_close"
+STAGE_HARVEST = "harvest"
+STAGE_PUBLISH = "publish"
+STAGE_SHIP_READBACK = "ship_readback"
+STAGE_SHIP_ENCODE = "ship_encode"
+STAGE_SHIP_SEND = "ship_send"
+STAGE_AGG_MERGE = "aggregator_merge"
+
+# Ordered registry (pipeline order); drives the fixed label space of
+# tpu_stage_seconds and the bench critical-path report.
+STAGES = (
+    STAGE_GENERATOR_EMIT,
+    STAGE_COMBINE,
+    STAGE_FEED_FILL,
+    STAGE_STAGING_HANDOFF,
+    STAGE_WIRE_BUILD,
+    STAGE_TRANSFER,
+    STAGE_DEVICE_STEP,
+    STAGE_WINDOW_CLOSE,
+    STAGE_HARVEST,
+    STAGE_PUBLISH,
+    STAGE_SHIP_READBACK,
+    STAGE_SHIP_ENCODE,
+    STAGE_SHIP_SEND,
+    STAGE_AGG_MERGE,
+)
+
 # Label keys (reference pkg/utils/metric_names.go label constants).
 L_DIRECTION = "direction"
 L_REASON = "reason"

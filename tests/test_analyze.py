@@ -23,6 +23,7 @@ from tools.analyze import (  # noqa: E402
     rt200,
     rt210,
     rt220,
+    rt226,
     rt230,
     rt300,
 )
@@ -510,6 +511,126 @@ def test_rt221_literal_for_declared_series(tmp_path):
     rep = Reporter()
     rt220.check_program(ctxs, rep, tmp_path)
     assert codes(rep.findings) == ["RT221"]
+
+
+# --------------------------------------------------------------- RT226
+
+STAGE_DECLS = """
+    STAGE_ALPHA = "alpha"
+    STAGE_BETA = "beta"
+
+    STAGES = (
+        STAGE_ALPHA,
+        STAGE_BETA,
+    )
+"""
+
+STAGE_TABLE_OK = """\
+<!-- stage-table-begin -->
+| Stage | What |
+|---|---|
+| `alpha` | first |
+| `beta` | second |
+<!-- stage-table-end -->
+"""
+
+
+def _rt226_repo(tmp_path, metrics_src: str, usage_src: str,
+                doc_obs: str):
+    files = {
+        "retina_tpu/utils/metric_names.py": metrics_src,
+        "retina_tpu/app.py": usage_src,
+        "docs/observability.md": doc_obs,
+    }
+    ctxs = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        if rel.endswith(".py"):
+            ctxs.append(FileCtx(p, rel, p.read_text()))
+    return ctxs
+
+
+def test_rt226_clean(tmp_path):
+    ctxs = _rt226_repo(
+        tmp_path,
+        metrics_src=STAGE_DECLS,
+        usage_src="""
+            from retina_tpu.utils import metric_names as mn
+
+            def work(rec, t0):
+                rec.record(mn.STAGE_ALPHA, t0)
+                rec.record(mn.STAGE_BETA, t0)
+                ring.span()  # unrelated .span method: out of scope
+        """,
+        doc_obs=STAGE_TABLE_OK,
+    )
+    rep = Reporter()
+    rt226.check_program(ctxs, rep, tmp_path)
+    assert rep.findings == []
+
+
+def test_rt226_drift_every_direction(tmp_path):
+    ctxs = _rt226_repo(
+        tmp_path,
+        metrics_src="""
+            STAGE_ALPHA = "alpha"
+            STAGE_BETA = "beta"
+            STAGE_ORPHAN = "orphan"
+
+            STAGES = (
+                STAGE_ALPHA,
+                STAGE_BETA,
+            )
+        """,
+        usage_src="""
+            from retina_tpu.utils import metric_names as mn
+
+            def work(rec, t0):
+                rec.record(mn.STAGE_ALPHA, t0)
+                rec.record("beta", t0)          # literal
+                rec.record(mn.STAGE_GHOST, t0)  # undeclared
+        """,
+        doc_obs="""\
+            <!-- stage-table-begin -->
+            | Stage | What |
+            |---|---|
+            | `alpha` | first |
+            | `phantom` | not a stage |
+            <!-- stage-table-end -->
+        """,
+    )
+    rep = Reporter()
+    rt226.check_program(ctxs, rep, tmp_path)
+    assert all(f.code == "RT226" for f in rep.findings)
+    keys = {f.key for f in rep.findings}
+    assert "RT226:tuple:STAGE_ORPHAN" in keys       # not in STAGES
+    assert "RT226:retina_tpu/app.py:beta" in keys   # literal span
+    assert "RT226:retina_tpu/app.py:STAGE_GHOST" in keys
+    assert "RT226:unused:STAGE_BETA" in keys        # never emitted
+    assert "RT226:unused:STAGE_ORPHAN" in keys
+    assert "RT226:doc-missing:beta" in keys
+    assert "RT226:doc-missing:orphan" in keys
+    assert "RT226:doc-unknown:phantom" in keys
+
+
+def test_rt226_missing_stage_table(tmp_path):
+    ctxs = _rt226_repo(
+        tmp_path,
+        metrics_src=STAGE_DECLS,
+        usage_src="""
+            from retina_tpu.utils import metric_names as mn
+
+            def work(rec, t0):
+                rec.record(mn.STAGE_ALPHA, t0)
+                rec.record(mn.STAGE_BETA, t0)
+        """,
+        doc_obs="no markers here\n",
+    )
+    rep = Reporter()
+    rt226.check_program(ctxs, rep, tmp_path)
+    assert [f.key for f in rep.findings] == ["RT226:doc:no-table"]
 
 
 def test_rt230_family(tmp_path):

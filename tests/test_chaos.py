@@ -474,4 +474,8 @@ def test_fleet_node_dropout_rollup_continues():
     assert res["straggled_epochs"] >= 1, res
     # Guardrail: per-tenant exported series bounded by the knob.
     assert res["tenant_series_max_observed"] <= res["tenant_series_bound"]
+    # Span lineage (obs/recorder.py): every merged epoch's ship span
+    # and aggregator merge span share the window-epoch trace ID
+    # carried in the RFLT trace-context header.
+    assert res["trace_lineage_ok"], res
     assert res["ok"], res

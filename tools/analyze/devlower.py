@@ -672,7 +672,7 @@ def entry_audits() -> list[EntryAudit]:
     audits.append(
         _audit(
             "sharded.snapshot",
-            st._build_snapshot().lower(state, u),
+            st._build_snapshot()._jitted.lower(state, u),
             2,
             keep=(0,),  # snapshot must NOT consume resident state
             allowed=frozenset({"all-reduce", "all-gather"}),
@@ -681,7 +681,7 @@ def entry_audits() -> list[EntryAudit]:
     audits.append(
         _audit(
             "sharded.fleet_export",
-            st._build_fleet_export().lower(state),
+            st._build_fleet_export()._jitted.lower(state),
             1,
             keep=(0,),
             allowed=frozenset({"all-reduce", "all-gather"}),
@@ -690,7 +690,7 @@ def entry_audits() -> list[EntryAudit]:
     audits.append(
         _audit(
             "sharded.inv_decode",
-            st._build_inv_decode().lower(state, u),
+            st._build_inv_decode()._jitted.lower(state, u),
             2,
             keep=(0,),
             allowed=frozenset({"all-reduce"}),
@@ -700,7 +700,7 @@ def entry_audits() -> list[EntryAudit]:
     audits.append(
         _audit(
             "sharded.snapshot_flat",
-            flat_fn.lower(state, u),
+            flat_fn._jitted.lower(state, u),
             2,
             keep=(0,),
             allowed=frozenset({"all-reduce", "all-gather"}),

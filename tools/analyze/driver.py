@@ -2,7 +2,8 @@
 module, apply the baseline, exit nonzero on any live finding.
 
 Per-file rules (generic, rt10x, rt200, rt210) see one FileCtx at a
-time; whole-program rules (rt220, rt230) see the full parsed set —
+time; whole-program rules (rt220, rt226, rt230) see the full parsed
+set —
 they cross-reference metric/config declarations, use sites and docs,
 so they always scan the complete default file set even when the CLI
 restricts which files findings are *reported* for.
@@ -20,7 +21,7 @@ import time
 from pathlib import Path
 
 from tools.analyze import (
-    generic, rt10x, rt200, rt210, rt220, rt225, rt230, rt300,
+    generic, rt10x, rt200, rt210, rt220, rt225, rt226, rt230, rt300,
 )
 from tools.analyze.core import (
     FileCtx,
@@ -46,7 +47,8 @@ FILE_RULES = (
     generic.check, rt10x.check, rt200.check, rt210.check, rt300.check,
 )
 PROGRAM_RULES = (
-    rt220.check_program, rt225.check_program, rt230.check_program,
+    rt220.check_program, rt225.check_program, rt226.check_program,
+    rt230.check_program,
 )
 
 RULE_FAMILIES = {
@@ -66,6 +68,9 @@ RULE_FAMILIES = {
              "mentions unknown series, RT224 declared-but-unused)",
     "RT225": "fleet codec op class unresolvable or lacking a "
              "merge-associativity property test",
+    "RT226": "recorder span-name drift (literal/undeclared stage, "
+             "stage never emitted, or docs/observability.md stage "
+             "table out of sync with the STAGE_ registry)",
     "RT230": "unknown cfg.<attr> access (+RT231 field never read, "
              "RT232 field undocumented)",
     "RT205": "lock-acquisition order cycle (potential deadlock "

@@ -1,0 +1,171 @@
+"""RT226 — recorder span-name drift (whole-program).
+
+The contract (the RT220 analog for the flight recorder): the
+``STAGE_*`` constants in ``utils/metric_names.py`` are the single
+registry of pipeline stage names; every span emitted through
+``FlightRecorder.record`` resolves to a registry constant; the stage
+table in ``docs/observability.md`` (between the ``stage-table-begin``/
+``stage-table-end`` markers) lists every stage and mentions no stage
+that does not exist. Drift in any direction is a finding:
+
+  RT226 span recorded under a stage not declared in the registry
+        (string literal, unknown STAGE_* reference, or a registry
+        constant missing from the STAGES tuple);
+        a registry stage never emitted through any recorder; or
+        the docs/observability.md stage table out of sync with the
+        registry (either direction).
+
+Scope: ``record(...)`` calls under ``retina_tpu/`` whose first
+argument is a string literal or a ``STAGE_``-prefixed name — other
+``.record(...)`` methods (different first-arg shapes) are out of
+scope by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.analyze.core import FileCtx, Reporter
+
+METRIC_NAMES_REL = "retina_tpu/utils/metric_names.py"
+DOC_REL = "docs/observability.md"
+TABLE_BEGIN = "<!-- stage-table-begin -->"
+TABLE_END = "<!-- stage-table-end -->"
+DOC_STAGE_RE = re.compile(r"`([a-z0-9_]+)`")
+
+
+def _stage_registry(ctx: FileCtx) -> dict[str, tuple[str, int]]:
+    """STAGE_* const name -> (stage string, decl lineno)."""
+    out: dict[str, tuple[str, int]] = {}
+    for stmt in ctx.tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id.startswith("STAGE_")
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)):
+            out[stmt.targets[0].id] = (stmt.value.value, stmt.lineno)
+    return out
+
+
+def _stages_tuple(ctx: FileCtx) -> set[str]:
+    """Constant names listed in the ordered STAGES tuple."""
+    for stmt in ctx.tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "STAGES"
+                and isinstance(stmt.value, ast.Tuple)):
+            return {
+                e.id for e in stmt.value.elts if isinstance(e, ast.Name)
+            }
+    return set()
+
+
+def check_program(ctxs: list[FileCtx], rep: Reporter, root: Path) -> None:
+    by_rel = {c.rel: c for c in ctxs}
+    mn_ctx = by_rel.get(METRIC_NAMES_REL)
+    if mn_ctx is None:
+        return
+    registry = _stage_registry(mn_ctx)  # const name -> (value, lineno)
+    values = {v for v, _ in registry.values()}
+    in_tuple = _stages_tuple(mn_ctx)
+
+    # A declared constant absent from the ordered STAGES tuple never
+    # gets its histogram child pre-ordered in stage_report — drift.
+    for name, (value, lineno) in sorted(registry.items()):
+        if name not in in_tuple:
+            rep.add(mn_ctx, lineno, "RT226",
+                    f"stage constant {name} (\"{value}\") is missing "
+                    "from the STAGES tuple",
+                    key=f"RT226:tuple:{name}")
+
+    # --- emission sites: record(<stage>, ...) under retina_tpu/ ------
+    emitted: set[str] = set()
+    prod = [
+        c for c in ctxs
+        if c.rel.startswith("retina_tpu/") and c.rel != METRIC_NAMES_REL
+    ]
+    for ctx in prod:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr == "record"):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                rep.add(ctx, node.lineno, "RT226",
+                        f'span "{arg.value}" recorded from a literal — '
+                        "use the utils.metric_names STAGE_ constant",
+                        key=f"RT226:{ctx.rel}:{arg.value}")
+                continue
+            const = None
+            if isinstance(arg, ast.Attribute):
+                const = arg.attr
+            elif isinstance(arg, ast.Name):
+                const = arg.id
+            if const is None or not const.startswith("STAGE_"):
+                continue  # some other .record() method — out of scope
+            if const not in registry:
+                rep.add(ctx, node.lineno, "RT226",
+                        f"span constant {const} is not declared in "
+                        "utils/metric_names.py",
+                        key=f"RT226:{ctx.rel}:{const}")
+            else:
+                emitted.add(const)
+
+    # --- declared but never emitted ----------------------------------
+    for name, (value, lineno) in sorted(registry.items()):
+        if name not in emitted:
+            rep.add(mn_ctx, lineno, "RT226",
+                    f"stage constant {name} (\"{value}\") is never "
+                    "emitted through a recorder span",
+                    key=f"RT226:unused:{name}")
+
+    # --- docs/observability.md stage table, two-way ------------------
+    doc_path = root / DOC_REL
+    doc_lines = (
+        doc_path.read_text().splitlines() if doc_path.exists() else []
+    )
+    doc_ctx = FileCtx.__new__(FileCtx)  # lightweight shell for .md
+    doc_ctx.path = doc_path
+    doc_ctx.rel = DOC_REL
+    doc_ctx.src = "\n".join(doc_lines)
+    doc_ctx.lines = doc_lines
+    doc_ctx.tree = None
+    doc_ctx.syntax_error = None
+
+    table: dict[str, int] = {}  # stage token -> doc lineno
+    inside = False
+    for i, line in enumerate(doc_lines, start=1):
+        if TABLE_BEGIN in line:
+            inside = True
+            continue
+        if TABLE_END in line:
+            inside = False
+            continue
+        if inside:
+            m = DOC_STAGE_RE.search(line)
+            if m:
+                table.setdefault(m.group(1), i)
+
+    if not table:
+        rep.add(doc_ctx, 1, "RT226",
+                f"{DOC_REL} has no stage table between the "
+                f"{TABLE_BEGIN} / {TABLE_END} markers",
+                key="RT226:doc:no-table")
+        return
+    for value in sorted(values):
+        if value not in table:
+            rep.add(doc_ctx, 1, "RT226",
+                    f'stage "{value}" has no row in the {DOC_REL} '
+                    "stage table",
+                    key=f"RT226:doc-missing:{value}")
+    for tok, lineno in sorted(table.items()):
+        if tok not in values:
+            rep.add(doc_ctx, lineno, "RT226",
+                    f'{DOC_REL} stage table mentions "{tok}" which is '
+                    "not declared in utils/metric_names.py",
+                    key=f"RT226:doc-unknown:{tok}")
