@@ -1,8 +1,8 @@
 # Developer entry points. CI runs the same commands
 # (.github/workflows/); the driver runs bench.py directly.
 
-.PHONY: test native bench bench-smoke soak distributed chaos lint \
-	analyze-device query-dryrun trace-dryrun clean
+.PHONY: test native bench bench-smoke soak soak-smoke distributed \
+	chaos lint analyze-device query-dryrun trace-dryrun clean
 
 native:
 	$(MAKE) -C retina_tpu/native
@@ -34,6 +34,14 @@ trace-dryrun: native
 soak: native
 	RETINA_SOAK=1 RETINA_SOAK_SECONDS=300 \
 	    python -m pytest tests/test_soak.py -q
+
+# Endurance soak, CI-sized: live agent + 2 heavy-tail regimes + 1
+# injected fault, every leak sentinel sampled per window, <=90 s.
+# Emits SOAK_*.json; exit code is the sentinel verdict. The full
+# rotation (>=30 min, 6 regimes, alternating faults) is
+# `python bench.py --soak --soak-seconds 1800` on hardware.
+soak-smoke: native
+	python bench.py --soak --smoke
 
 # Fault-injection suite: every injected fault (transfer error, hung
 # harvest, plugin crash, corrupt checkpoint) must recover in-process.

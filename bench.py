@@ -1058,6 +1058,17 @@ def main() -> None:
                          "aggregator decodes heavy-flow keys from the "
                          "merged invertible sketch, through a forced "
                          "SHEDDING episode")
+    ap.add_argument("--soak", action="store_true",
+                    help="endurance soak: boot the live agent and walk "
+                         "a rotating schedule of heavy-tail traffic "
+                         "regimes + injected faults while leak "
+                         "sentinels sample every window; writes a "
+                         "SOAK_*.json scorecard (with --smoke: 2 "
+                         "phases + 1 fault, <=90s for CI)")
+    ap.add_argument("--soak-seconds", type=float, default=None,
+                    metavar="S",
+                    help="wall-clock budget for --soak (default: 60 "
+                         "with --smoke, else cfg.soak_seconds = 1800)")
     ap.add_argument("--query-dryrun", action="store_true",
                     help="time-travel closed-loop dryrun: an entropy "
                          "burst is detected, the query ring is folded "
@@ -1068,7 +1079,29 @@ def main() -> None:
                          "the query API")
     args = ap.parse_args()
     try:
-        if args.query_dryrun:
+        if args.soak:
+            from retina_tpu.soak import run_soak
+
+            res = run_soak(
+                total_s=args.soak_seconds, smoke=args.smoke, log=log,
+            )
+            n_ok = sum(1 for v in res["sentinels"].values() if v["ok"])
+            out = {
+                # Acceptance: every leak/degradation sentinel green
+                # across the full regime+fault rotation. The headline
+                # is the sentinel pass fraction so a partial failure
+                # is visible even before reading the artifact.
+                "metric": "soak_sentinels_green",
+                "value": n_ok,
+                "unit": "sentinels",
+                "vs_baseline": round(n_ok / len(res["sentinels"]), 4),
+                "extra": res,
+            }
+            if not res["ok"]:
+                bad = [k for k, v in res["sentinels"].items()
+                       if not v["ok"]]
+                out["error"] = f"soak sentinels failed: {bad}"
+        elif args.query_dryrun:
             from retina_tpu.timetravel.dryrun import run_query_dryrun
 
             res = run_query_dryrun(log=log)

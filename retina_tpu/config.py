@@ -395,6 +395,26 @@ class Config:
     profile_cooldown_s: float = 30.0  # min spacing between sessions
     profile_max_artifacts: int = 4
 
+    # --- endurance soak harness (soak/; bench.py --soak) ---
+    # Total soak wall clock for the default rotating schedule of
+    # heavy-tail regimes + injected faults (docs/operations.md §9).
+    soak_seconds: float = 1800.0
+    # Per-phase duration; 0 = divide soak_seconds evenly over the
+    # default schedule's phases.
+    soak_phase_seconds: float = 0.0
+    # After a phase's fault spec is cleared, the overload controller
+    # must report NOMINAL within this bound (the no-latch-up
+    # sentinel; recovery_seconds in the SOAK artifact).
+    soak_recovery_deadline_s: float = 30.0
+    # Post-warmup RSS leak gate: least-squares slope of the sampled
+    # RSS series must stay under this (MB per minute).
+    soak_rss_slope_mb_per_min: float = 5.0
+    # Flow-descriptor dictionary generation bumps tolerated per phase
+    # (the churn regimes cycle the table by design — but boundedly).
+    soak_fd_generations_per_phase: int = 8
+    # SOAK_*.json scorecard artifact directory.
+    soak_artifact_dir: str = "/tmp/retina-soak"
+
     # --- pipeline shapes (jit keys; see models/pipeline.py) ---
     n_pods: int = 1 << 12
     cms_width: int = 1 << 15
@@ -502,9 +522,17 @@ class Config:
                 raise ValueError(
                     f"{f} must be >= 0, got {getattr(self, f)}"
                 )
-        if self.gen_preset not in ("default", "zipf", "uniform"):
+        # Single source of truth for legal preset names: the PRESETS
+        # table in events/synthetic.py (a name added there is legal
+        # here automatically — no hand-maintained copy to drift, the
+        # RT230 philosophy). Local import like validate_shed_order
+        # above: synthetic pulls numpy at module load, which config
+        # must not do for bare Config() construction.
+        from retina_tpu.events.synthetic import PRESETS as _gen_presets
+
+        if self.gen_preset not in _gen_presets:
             raise ValueError(
-                "gen_preset must be 'default', 'zipf' or 'uniform', "
+                f"gen_preset must be one of {sorted(_gen_presets)}, "
                 f"got {self.gen_preset!r}"
             )
         for f in ("timetravel_ring_windows", "timetravel_query_topk",
@@ -553,6 +581,22 @@ class Config:
             raise ValueError(
                 f"invertible_min_weight must be >= 0, "
                 f"got {self.invertible_min_weight}"
+            )
+        for f in ("soak_seconds", "soak_recovery_deadline_s",
+                  "soak_rss_slope_mb_per_min"):
+            if getattr(self, f) <= 0:
+                raise ValueError(
+                    f"{f} must be > 0, got {getattr(self, f)}"
+                )
+        if self.soak_phase_seconds < 0:
+            raise ValueError(
+                f"soak_phase_seconds must be >= 0, "
+                f"got {self.soak_phase_seconds}"
+            )
+        if self.soak_fd_generations_per_phase < 1:
+            raise ValueError(
+                f"soak_fd_generations_per_phase must be >= 1, "
+                f"got {self.soak_fd_generations_per_phase}"
             )
         for f in ("trace_sample_every", "trace_ring_spans",
                   "profile_max_artifacts"):

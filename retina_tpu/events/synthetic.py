@@ -44,14 +44,42 @@ POD_NET = 0x0A000000  # 10.0.0.0/8: pod IPs are POD_NET + pod_index
 # exponent concentrates traffic on a handful of flows (the PSketch
 # skew on real eBPF feeds); "uniform" flattens the flow-size
 # distribution toward the top-k worst case. "default" applies nothing.
-PRESETS: dict[str, dict[str, float]] = {
+#
+# The four named attack/churn regimes are the PSketch workloads real
+# eBPF feeds produce (PAPERS.md, arxiv 2509.07338) — each sets
+# ``mode`` (a batch-shaping pass in TrafficGen.batch) plus the
+# distribution params that make the regime adversarial for a specific
+# subsystem: dns_flood hammers the qname path + DNS string table,
+# syn_storm floods half-open TCP (entropy detector + drop accounting),
+# conntrack_churn gives almost every event a fresh ephemeral 5-tuple
+# (flow-dict/descriptor-table churn), elephant_mice splits bytes
+# bimodally between a few huge flows and a long mouse tail (top-k vs
+# CMS tension).
+#
+# This table is the SINGLE source of legal preset names:
+# config.Config.validate checks ``gen_preset`` against it, and
+# tests/test_soak_harness.py cross-checks table ↔ validation ↔ docs so
+# a preset added in one place cannot drift from the others (the RT230
+# knob-drift philosophy applied to regimes).
+PRESETS: dict[str, dict[str, float | str]] = {
     "default": {},
     "zipf": {"zipf_a": 1.6},
     "uniform": {"zipf_a": 1.001},
+    "dns_flood": {"mode": "dns_flood", "dns_fraction": 0.8,
+                  "zipf_a": 1.5},
+    "syn_storm": {"mode": "syn_storm", "zipf_a": 1.05,
+                  "drop_fraction": 0.15},
+    "conntrack_churn": {"mode": "conntrack_churn", "zipf_a": 1.05},
+    "elephant_mice": {"mode": "elephant_mice", "zipf_a": 2.0},
 }
 
+# Legal TrafficGen.mode values ("mix" is the default mixed TCP/UDP
+# forward/drop/DNS blend the original generator produced).
+MODES = ("mix", "dns_flood", "syn_storm", "conntrack_churn",
+         "elephant_mice")
 
-def preset_params(name: str) -> dict[str, float]:
+
+def preset_params(name: str) -> dict[str, float | str]:
     """Overrides for one preset; unknown names raise (config.validate
     rejects them earlier — this guards direct library callers)."""
     try:
@@ -79,9 +107,18 @@ class TrafficGen:
     zipf_a: float = 1.2
     drop_fraction: float = 0.02
     dns_fraction: float = 0.01
+    # Batch-shaping regime (MODES): "mix" is the classic blend; the
+    # named attack/churn regimes reshape each batch after the base
+    # sampling pass (see _shape_regime).
+    mode: str = "mix"
     seed: int = 0
 
     def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(
+                f"TrafficGen mode must be one of {MODES}, "
+                f"got {self.mode!r}"
+            )
         rng = np.random.default_rng(self.seed)
         n = self.n_flows
         self.src_pod = rng.integers(1, self.n_pods, n).astype(np.uint32)
@@ -152,6 +189,82 @@ class TrafficGen:
         qtype = rng.choice(np.array([1, 28, 5], np.uint32), n_events)
         rec[is_dns, F.DNS] = (qtype[is_dns] << np.uint32(16)).astype(np.uint32)
         rec[is_dns, F.DNS_QHASH] = (fid[is_dns] & 0xFFFF).astype(np.uint32)
+        return self._shape_regime(rec, fid)
+
+    def _shape_regime(self, rec: np.ndarray, fid: np.ndarray) -> np.ndarray:
+        """Reshape one sampled batch into the active attack/churn
+        regime (PRESETS table). Runs after the base "mix" pass so every
+        regime keeps the same ground-truth flow accounting
+        (``true_counts`` tracks fid regardless of shaping)."""
+        if self.mode == "mix":
+            return rec
+        rng = self._rng
+        n = len(rec)
+        if self.mode == "dns_flood":
+            # Query flood: the dns_fraction share (0.8 under the
+            # preset) all targets a handful of resolver pods over
+            # UDP:53 with tiny frames — the qname-hash path and the
+            # host DNS string table carry the regime's weight.
+            is_dns = np.isin(
+                rec[:, F.EVENT_TYPE],
+                np.array([EV_DNS_REQ, EV_DNS_RESP], np.uint32),
+            )
+            resolvers = (POD_NET + 1 + (fid % 4)).astype(np.uint32)
+            rec[is_dns, F.DST_IP] = resolvers[is_dns]
+            rec[is_dns, F.PORTS] = (
+                rec[is_dns, F.PORTS] & np.uint32(0xFFFF0000)
+            ) | np.uint32(53)
+            rec[is_dns, F.META] = (
+                rec[is_dns, F.META] & np.uint32(0x00FFFFFF)
+            ) | (np.uint32(PROTO_UDP) << np.uint32(24))
+            rec[is_dns, F.BYTES] = rng.integers(
+                64, 140, int(is_dns.sum())
+            ).astype(np.uint32)
+        elif self.mode == "syn_storm":
+            # Half-open flood: most rows become 64-byte TCP SYNs from
+            # spoofed (non-pod) sources onto a few victim pods —
+            # src-IP entropy spikes, dst-IP entropy collapses, and the
+            # preset's drop_fraction models the policy drops.
+            storm = rng.random(n) < 0.9
+            ns = int(storm.sum())
+            rec[storm, F.SRC_IP] = rng.integers(
+                0xC6000000, 0xC7000000, ns
+            ).astype(np.uint32)
+            victims = (POD_NET + 1 + (fid % 8)).astype(np.uint32)
+            rec[storm, F.DST_IP] = victims[storm]
+            rec[storm, F.META] = (
+                (np.uint32(PROTO_TCP) << np.uint32(24))
+                | (np.uint32(TCP_SYN) << np.uint32(16))
+                | (np.uint32(OP_FROM_NETWORK) << np.uint32(8))
+                | (np.uint32(DIR_INGRESS) << np.uint32(4))
+            )
+            rec[storm, F.BYTES] = 64
+        elif self.mode == "conntrack_churn":
+            # Short-lived connections: every event gets a fresh
+            # ephemeral source port, so nearly every combined row is a
+            # DISTINCT 5-tuple — the flow-descriptor dictionary and
+            # conntrack table churn instead of settling (the regime
+            # the soak fd-churn sentinel bounds).
+            eph = rng.integers(1024, 65536, n).astype(np.uint32)
+            rec[:, F.PORTS] = (eph << np.uint32(16)) | (
+                rec[:, F.PORTS] & np.uint32(0xFFFF)
+            )
+            syn = rng.random(n) < 0.3
+            rec[syn, F.META] = (
+                rec[syn, F.META] & np.uint32(0xFF00FFFF)
+            ) | (np.uint32(TCP_SYN) << np.uint32(16))
+        elif self.mode == "elephant_mice":
+            # Bimodal sizes: the steep-Zipf head flows carry MTU-sized
+            # frames while the mouse tail sends minimum-size ones —
+            # byte-weighted top-k and count-weighted CMS disagree by
+            # construction.
+            elephant = fid < max(1, self.n_flows // 100)
+            rec[elephant, F.BYTES] = rng.integers(
+                1400, 1501, int(elephant.sum())
+            ).astype(np.uint32)
+            rec[~elephant, F.BYTES] = rng.integers(
+                64, 200, int((~elephant).sum())
+            ).astype(np.uint32)
         return rec
 
     def true_counts(self) -> np.ndarray:

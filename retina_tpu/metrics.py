@@ -207,6 +207,13 @@ class Metrics:
             mn.AUTOCAPTURE_ARTIFACT_BYTES, []
         )
         self.autocapture_last_epoch = g(mn.AUTOCAPTURE_LAST_EPOCH, [])
+        # Endurance soak harness (soak/runner.py): phase progress +
+        # sentinel verdicts, scrapeable mid-soak.
+        self.soak_phases = c(mn.TPU_SOAK_PHASES, [])
+        self.soak_sentinel_failures = c(
+            mn.TPU_SOAK_SENTINEL_FAILURES, [mn.L_SENTINEL]
+        )
+        self.soak_recovery_seconds = g(mn.TPU_SOAK_RECOVERY_SECONDS, [])
         # Flight recorder (obs/recorder.py): per-stage span latency.
         # Label space is the FIXED stage registry (mn.STAGES); buckets
         # span sub-ms host hops to multi-second device round-trips.

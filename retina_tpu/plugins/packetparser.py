@@ -40,9 +40,10 @@ class PacketParserPlugin(Plugin):
         super().__init__(cfg)
         self._gen: TrafficGen | None = None
         self._pregen: list[np.ndarray] | None = None
-        self._pcap_records: np.ndarray | None = None
+        self._replay = None  # PcapReplaySource (event_source=pcap)
         self.dns_names: dict[int, str] = {}
         self._sock = None
+        self._regime_switches = 0
 
     # -- lifecycle ---------------------------------------------------
     def generate(self) -> None:
@@ -76,10 +77,26 @@ class PacketParserPlugin(Plugin):
             if self.cfg.synthetic_pregen > 0:
                 self._pregen = []
         elif src == "pcap":
-            from retina_tpu.sources.pcapdecode import decode_pcap_file
+            from retina_tpu.sources.pcapreplay import (
+                PcapReplaySource, safe_decode_bytes,
+            )
 
-            res = decode_pcap_file(self.cfg.pcap_path)
-            self._pcap_records = res.records
+            with open(self.cfg.pcap_path, "rb") as fh:
+                sd = safe_decode_bytes(fh.read())
+            # Degrade, never crash: a truncated tail decodes its
+            # prefix; an undecodable blob replays as empty. Either way
+            # the gap is a COUNTED drop — compile() raising here would
+            # take the whole source down over an operator-supplied
+            # file (sources/pcapreplay.py).
+            if sd.dropped:
+                self.count_lost("decode", sd.dropped)
+            if sd.error:
+                self.log.error(
+                    "pcap %s undecodable (%s): replaying empty, "
+                    "drop counted", self.cfg.pcap_path, sd.error,
+                )
+            res = sd.result
+            self._replay = PcapReplaySource(res.records, block=BLOCK)
             self.dns_names = res.dns_names
             self.log.info(
                 "pcap decoded: %d/%d packets from %s",
@@ -96,6 +113,27 @@ class PacketParserPlugin(Plugin):
         from retina_tpu.pubsub import get_pubsub
 
         get_pubsub().publish(TOPIC_DNS_NAMES, dict(names))
+
+    def set_regime(self, preset: str) -> None:
+        """Swap the synthetic generator's traffic regime LIVE (the soak
+        harness rotates heavy-tail regimes mid-run). Atomic reference
+        assignment: the feed loop reads ``self._gen`` once per block,
+        so the switch lands on a block boundary with no lock. No-op
+        for non-synthetic sources; the pre-generated ring (if any) is
+        intentionally left alone — a soak runs with
+        ``synthetic_pregen=0`` so every block reflects the active
+        regime.
+        """
+        if self.cfg.event_source != "synthetic" or self._gen is None:
+            return
+        self._regime_switches += 1
+        self._gen = TrafficGen(
+            n_flows=self.cfg.synthetic_flows, n_pods=self.cfg.n_pods,
+            seed=self._regime_switches,
+            **preset_params(preset),
+        )
+        self.log.info("traffic regime -> %r (%s)", preset,
+                      preset_params(preset))
 
     def init(self) -> None:
         if self.cfg.event_source == "live":
@@ -193,24 +231,26 @@ class PacketParserPlugin(Plugin):
                 next_t = time.monotonic()  # behind: don't accumulate debt
 
     def _run_pcap(self, stop: threading.Event) -> None:
-        recs = self._pcap_records
-        assert recs is not None
-        if len(recs) == 0:
+        replay = self._replay
+        assert replay is not None
+        if len(replay) == 0:
             self.log.warning("pcap replay: no decodable packets")
             stop.wait()
             return
-        pos = 0
+        # Looping replay (sources/pcapreplay.py): each pass re-emits
+        # the capture with TS lanes rebased one capture-span forward,
+        # so replayed time advances monotonically across loop seams
+        # instead of jumping back to the capture start.
         while not stop.is_set():
-            block = recs[pos : pos + BLOCK]
-            self.emit(block)
-            pos += BLOCK
-            if pos >= len(recs):
-                if not self.cfg.pcap_loop:
-                    self.log.info("pcap replay complete")
+            for block in replay.blocks():
+                if stop.is_set():
                     return
-                pos = 0
-            if self.cfg.synthetic_rate > 0:
-                stop.wait(len(block) / self.cfg.synthetic_rate)
+                self.emit(block)
+                if self.cfg.synthetic_rate > 0:
+                    stop.wait(len(block) / self.cfg.synthetic_rate)
+            if not self.cfg.pcap_loop:
+                self.log.info("pcap replay complete")
+                return
 
     def _run_live_native(self, stop: threading.Event) -> bool:
         """TPACKET_V3 mmap ring capture (native/afpacket.cpp): the

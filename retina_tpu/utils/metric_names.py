@@ -222,6 +222,19 @@ AUTOCAPTURE_KEYS = AUTOCAPTURE_PREFIX + "attributed_keys"
 AUTOCAPTURE_ARTIFACT_BYTES = AUTOCAPTURE_PREFIX + "artifact_bytes"
 AUTOCAPTURE_LAST_EPOCH = AUTOCAPTURE_PREFIX + "last_epoch"
 
+# Endurance soak harness (retina_tpu/soak/): phase progress and
+# sentinel verdicts for a live `bench.py --soak` run, scrapeable
+# mid-soak so an operator (or the alert rules) can watch a multi-hour
+# run without waiting for the SOAK_*.json artifact. `sentinel` is the
+# fixed verdict set the runner evaluates (rss_flat, fd_churn,
+# stalled_windows, recorder, aot_cache, overload_recovery);
+# last_recovery_seconds is the most recent fault-clear -> NOMINAL
+# latency.
+TPU_SOAK_PREFIX = PREFIX + "tpu_soak_"
+TPU_SOAK_PHASES = TPU_SOAK_PREFIX + "phases_completed_counter"
+TPU_SOAK_SENTINEL_FAILURES = TPU_SOAK_PREFIX + "sentinel_failures_counter"
+TPU_SOAK_RECOVERY_SECONDS = TPU_SOAK_PREFIX + "last_recovery_seconds"
+
 # Flight recorder (retina_tpu/obs/): per-window stage-latency
 # breakdown. tpu_stage_seconds{stage} is observed once per SAMPLED span
 # by the recorder; build_info is a constant-1 gauge whose labels
@@ -300,3 +313,4 @@ L_NODE = "node"
 L_SERVICE = "service"
 L_RING = "ring"
 L_STATUS = "status"
+L_SENTINEL = "sentinel"

@@ -88,6 +88,64 @@ class TestFlightRecorder:
         assert len(spans) == 16
         assert spans[-1]["t0"] == 99.0
 
+    def test_ring_wrap_thousands_reads_well_formed(self):
+        """Tier-1 wrap gate: thousands of REAL wraps on a tiny ring,
+        then every read surface (spans/chrome_trace/stage_report) must
+        stay well-formed — including with a torn slot present and with
+        the count counter pushed past 1M (Python ints: the counter
+        must never truncate or go negative, there is no 32-bit wrap)."""
+        rec = FlightRecorder(capacity=16)
+        rec._metrics_broken = True  # skip exposition: pure ring path
+        n = 64_000  # 4000 full wraps of the 16-slot ring
+        for i in range(n):
+            # t0 strictly > 0.0 (0.0 is the sampled-out sentinel)
+            rec.record(mn.STAGE_PUBLISH, i + 1.0, trace_id=i,
+                       t1=i + 1.5)
+        ring = rec._ring()
+        assert ring.count == n  # exact, monotonic
+        assert ring.pos == n % 16
+        # Torn slot mid-ring: reader must skip it, writer never cares.
+        ring.slots[3][0] = mn.STAGE_HARVEST
+        ring.slots[3][1] = 9e9
+        ring.slots[3][2] = 1.0
+        spans = rec.spans()
+        assert len(spans) == 15  # capacity minus the torn slot
+        assert all(s["t1"] >= s["t0"] for s in spans)
+        assert spans[-1]["trace_id"] == n - 1  # newest retained
+        # Fabricate a multi-million historical count (a long soak's
+        # magnitude): diagnostics must report it exactly.
+        ring.count = 3_141_592_653
+        assert rec.stats()["threads"][ring.name] == 3_141_592_653
+        doc = rec.chrome_trace()
+        assert len(json.loads(json.dumps(doc))["traceEvents"]) >= 15
+        rep = rec.stage_report()
+        assert rep[mn.STAGE_PUBLISH]["count"] == 15
+
+    @pytest.mark.slow
+    def test_ring_wrap_past_one_million_real(self):
+        """>1M REAL spans through one 16-slot ring (the soak's order of
+        magnitude, no fabricated counters): count stays exact, reads
+        stay bounded and well-formed, the trace dump stays valid JSON."""
+        rec = FlightRecorder(capacity=16)
+        rec._metrics_broken = True
+        n = 1_200_000
+        for i in range(n):
+            rec.record(mn.STAGE_PUBLISH, i + 1.0, trace_id=i,
+                       t1=i + 1.5)
+        ring = rec._ring()
+        assert ring.count == n
+        assert ring.pos == n % 16
+        spans = rec.spans()
+        assert len(spans) == 16  # bounded by capacity, not history
+        assert [s["trace_id"] for s in spans] == list(
+            range(n - 16, n)
+        )
+        assert all(s["t1"] > s["t0"] for s in spans)
+        doc = json.loads(json.dumps(rec.chrome_trace()))
+        assert len([e for e in doc["traceEvents"]
+                    if e["ph"] == "X"]) == 16
+        assert rec.stage_report()[mn.STAGE_PUBLISH]["count"] == 16
+
     def test_stage_report_percentiles(self):
         rec = FlightRecorder(capacity=256)
         for i in range(100):
@@ -290,6 +348,22 @@ class TestDebugEndpoints:
         names = {e["name"] for e in doc["traceEvents"]
                  if e["ph"] == "X"}
         assert mn.STAGE_HARVEST in names
+
+    def test_trace_endpoint_valid_json_after_ring_wrap(self, debug_srv):
+        """/debug/trace must serve valid Chrome JSON after the ring has
+        wrapped thousands of times (bounded body, newest spans only) —
+        the soak hits this endpoint with span counts in the millions."""
+        srv, dbg = debug_srv()
+        dbg.recorder._metrics_broken = True
+        for i in range(20_000):  # many wraps of the default ring
+            dbg.recorder.record(mn.STAGE_PUBLISH, i + 1.0,
+                                trace_id=i, t1=i + 1.5)
+        code, body = _request(srv.port, "/debug/trace")
+        assert code == 200
+        doc = json.loads(body)  # raises = endpoint served torn JSON
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert 0 < len(xs) <= dbg.recorder.capacity
+        assert all(e["dur"] >= 0 for e in xs)
 
     def test_trace_bad_last_is_400(self, debug_srv):
         srv, _ = debug_srv()

@@ -1,0 +1,287 @@
+"""Endurance soak harness (retina_tpu/soak/): schedule shapes +
+validation, the preset cross-check (config.validate <-> synthetic
+PRESETS <-> docs — the RT230 philosophy applied to traffic regimes),
+sentinel verdicts over fabricated sample series, and a CI-sized
+in-process soak through the real Daemon."""
+
+import os
+import sys
+
+import pytest
+
+from retina_tpu.config import Config
+from retina_tpu.events.synthetic import MODES, PRESETS, TrafficGen
+from retina_tpu.runtime import faults
+from retina_tpu.soak.schedule import (
+    SoakPhase, default_schedule, validate_schedule,
+)
+from retina_tpu.soak.sentinels import (
+    SENTINELS, PhaseResult, Sample, evaluate_sentinels,
+    rss_slope_mb_per_min,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ schedule
+
+def test_smoke_schedule_shape():
+    sch = default_schedule(60.0, smoke=True)
+    assert len(sch) == 2
+    assert sum(p.duration_s for p in sch) == pytest.approx(60.0)
+    presets = {p.preset for p in sch}
+    assert len(presets) == 2  # two distinct regimes
+    faulted = [p for p in sch if p.fault_spec]
+    assert len(faulted) == 1  # exactly one injected fault
+    assert "press" in faulted[0].fault_spec
+
+
+def test_full_schedule_rotation_and_repeat():
+    sch = default_schedule(1800.0)
+    assert len(sch) == 6
+    assert sum(p.duration_s for p in sch) == pytest.approx(1800.0)
+    # Heavy-tail coverage: every PSketch regime appears.
+    presets = {p.preset for p in sch}
+    for regime in ("dns_flood", "syn_storm", "conntrack_churn",
+                   "elephant_mice"):
+        assert regime in presets
+    # Two press phases + one raise + one hang per rotation pass.
+    assert sum(1 for p in sch if p.fault_spec) == 4
+    # An hour repeats the same rotation: scorecards comparable.
+    sch2 = default_schedule(3600.0)
+    assert len(sch2) == 12
+    assert [p.preset for p in sch2[:6]] == [p.preset for p in sch2[6:]]
+    assert len({p.name for p in sch2}) == 12  # names stay unique
+
+
+def test_validate_schedule_rejects():
+    with pytest.raises(ValueError, match="empty"):
+        validate_schedule([])
+    with pytest.raises(ValueError, match="unknown preset"):
+        validate_schedule([SoakPhase("x", "nosuch", 1.0)])
+    with pytest.raises(ValueError, match="duration"):
+        validate_schedule([SoakPhase("x", "zipf", 0.0)])
+    with pytest.raises(ValueError, match="recovery_deadline"):
+        validate_schedule(
+            [SoakPhase("x", "zipf", 1.0, recovery_deadline_s=-1)]
+        )
+    # Fault specs are parsed by the REAL injector grammar.
+    with pytest.raises(ValueError, match="bad fault spec"):
+        validate_schedule(
+            [SoakPhase("x", "zipf", 1.0, fault_spec="transfer:bogus")]
+        )
+    assert not faults.armed()  # the dry run always disarms
+
+
+def test_validate_schedule_refuses_armed_layer():
+    faults.configure("transfer:raise@1")
+    try:
+        with pytest.raises(RuntimeError, match="disarmed"):
+            validate_schedule(
+                [SoakPhase("x", "zipf", 1.0, fault_spec="harvest:raise")]
+            )
+    finally:
+        faults.clear()
+
+
+# ------------------------------------------- preset cross-check (RT230)
+
+def test_presets_are_the_single_legal_source():
+    """config.validate, the generator, and the docs must agree on the
+    legal gen_preset names — the RT230 knob-drift philosophy applied
+    to traffic regimes."""
+    for name in PRESETS:
+        Config(gen_preset=name).validate()  # every preset is legal
+    with pytest.raises(ValueError, match="gen_preset"):
+        Config(gen_preset="not_a_preset").validate()
+    # Every mode a preset names is a mode the generator implements.
+    for name, params in PRESETS.items():
+        mode = params.get("mode", "mix")
+        assert mode in MODES, f"preset {name!r} names unknown mode"
+    # Docs row lists every preset by name.
+    with open(os.path.join(REPO, "docs", "configuration.md")) as f:
+        doc = f.read()
+    for name in PRESETS:
+        assert name in doc, f"preset {name!r} missing from docs"
+
+
+@pytest.mark.parametrize("preset", sorted(PRESETS))
+def test_every_preset_generates(preset):
+    gen = TrafficGen(n_flows=64, n_pods=16, seed=1,
+                     **{k: v for k, v in PRESETS[preset].items()})
+    rec = gen.batch(256)
+    assert rec.shape[0] == 256
+
+
+# ------------------------------------------------------------ sentinels
+
+def _sample(t, rss, **kw):
+    d = dict(
+        t=t, rss_mb=rss, events_in=int(t * 1000),
+        windows_closed=float(t), overload_state="NOMINAL",
+        pressure=0.0, fd_entries=100, fd_generation=0,
+        recorder_spans=int(t * 10) + 1, recorder_enabled=True,
+        aot_hits=5, aot_misses=2, aot_errors=0,
+    )
+    d.update(kw)
+    return Sample(**d)
+
+
+def _phase(name="p", fault="", closes=30.0, fd_delta=0,
+           recovery=None, deadline=30.0, samples=None):
+    return PhaseResult(
+        name=name, preset="zipf", fault_spec=fault, duration_s=30.0,
+        window_seconds=1.0,
+        samples=samples or [_sample(0.0, 100.0), _sample(30.0, 100.0)],
+        events_delta=10_000, closes_delta=closes,
+        fd_generation_delta=fd_delta, recovery_seconds=recovery,
+        recovery_deadline_s=deadline, stage_report={},
+    )
+
+
+def _verdict(verdicts, name):
+    (v,) = [v for v in verdicts if v.sentinel == name]
+    return v
+
+
+def _eval(phases, samples, **kw):
+    args = dict(rss_slope_bound_mb_per_min=5.0,
+                fd_generations_per_phase=8,
+                recorder_span_cost_us=4.0)
+    args.update(kw)
+    return evaluate_sentinels(phases, samples, **args)
+
+
+def test_rss_slope_flat_vs_leak():
+    flat = [_sample(t, 200.0 + (t % 3)) for t in range(0, 120, 2)]
+    assert rss_slope_mb_per_min(flat) < 1.0
+    # 0.5 MB/s leak = 30 MB/min — far over any sane bound.
+    leaky = [_sample(t, 200.0 + 0.5 * t) for t in range(0, 120, 2)]
+    assert rss_slope_mb_per_min(leaky) == pytest.approx(30.0, rel=0.05)
+    ok = _verdict(_eval([_phase()], flat), "rss_flat")
+    bad = _verdict(_eval([_phase()], leaky), "rss_flat")
+    assert ok.ok and not bad.ok
+
+
+def test_rss_slope_ignores_warmup_growth():
+    # 100 MB of warmup growth in the first third, dead flat after:
+    # the POST-warmup gate must pass.
+    ramp = [_sample(t, 200.0 + min(t, 40) * 2.5) for t in range(0, 120, 2)]
+    assert rss_slope_mb_per_min(ramp) < 5.0
+
+
+def test_fd_churn_bound():
+    vs = _eval([_phase(fd_delta=3), _phase(name="q", fd_delta=20)],
+               [_sample(0, 100), _sample(60, 100)])
+    v = _verdict(vs, "fd_churn")
+    assert not v.ok and v.value == 20
+
+
+def test_stalled_windows_floors():
+    # Clean phase must close ~duration/window; fault phase only needs 1.
+    healthy = _phase(closes=30.0)
+    stalled = _phase(name="s", closes=2.0)
+    faulted_slow = _phase(name="f", fault="transfer:raise@3", closes=1.0)
+    faulted_dead = _phase(name="d", fault="harvest:hang", closes=0.0)
+    samples = [_sample(0, 100), _sample(60, 100)]
+    assert _verdict(_eval([healthy, faulted_slow], samples),
+                    "stalled_windows").ok
+    assert not _verdict(_eval([stalled], samples), "stalled_windows").ok
+    assert not _verdict(_eval([faulted_dead], samples),
+                        "stalled_windows").ok
+
+
+def test_recorder_sentinel():
+    samples = [_sample(0, 100), _sample(60, 100)]
+    assert _verdict(_eval([_phase()], samples), "recorder").ok
+    # Dead recorder (disabled or no spans) fails...
+    dead = samples[:-1] + [_sample(60, 100, recorder_enabled=False)]
+    assert not _verdict(_eval([_phase()], dead), "recorder").ok
+    # ...and so does a degraded hot path, even with spans flowing.
+    slow = _eval([_phase()], samples, recorder_span_cost_us=80.0)
+    assert not _verdict(slow, "recorder").ok
+
+
+def test_aot_cache_sentinel_late_misses():
+    p1 = _phase(samples=[_sample(0, 100), _sample(30, 100, aot_misses=4)])
+    # Misses frozen after phase 1 -> ok.
+    steady = [_sample(0, 100),
+              _sample(60, 100, aot_misses=4)]
+    assert _verdict(_eval([p1, _phase(name="q")], steady),
+                    "aot_cache").ok
+    # New misses mid-soak = recompiles -> fail.
+    drift = [_sample(0, 100), _sample(60, 100, aot_misses=9)]
+    assert not _verdict(_eval([p1, _phase(name="q")], drift),
+                        "aot_cache").ok
+    # Any cache error fails regardless of misses.
+    errs = [_sample(0, 100), _sample(60, 100, aot_errors=1)]
+    assert not _verdict(_eval([p1, _phase(name="q")], errs),
+                        "aot_cache").ok
+
+
+def test_overload_recovery_sentinel():
+    samples = [_sample(0, 100), _sample(60, 100)]
+    fast = _phase(fault="transfer:raise@3", recovery=3.0, deadline=30.0)
+    late = _phase(name="l", fault="harvest:hang2", recovery=45.0,
+                  deadline=30.0)
+    assert _verdict(_eval([fast], samples), "overload_recovery").ok
+    assert not _verdict(_eval([late], samples), "overload_recovery").ok
+    # Ending the soak outside NOMINAL = hysteresis latch-up.
+    latched = _eval([fast], samples, final_overload_state="SHEDDING")
+    assert not _verdict(latched, "overload_recovery").ok
+
+
+def test_verdict_set_is_complete():
+    vs = _eval([_phase()], [_sample(0, 100), _sample(60, 100)])
+    assert tuple(v.sentinel for v in vs) == SENTINELS
+    for v in vs:
+        d = v.as_dict()
+        assert {"sentinel", "ok", "value", "detail"} <= set(d)
+
+
+# ------------------------------------------------- in-process CI soak
+
+def test_run_soak_smoke_in_process(tmp_path):
+    """A CI-sized soak through the REAL Daemon: two regimes, one
+    bounded press fault, every sentinel sampled and the artifact
+    written. Short phases cannot gate an MB/min RSS slope (warmup
+    dominates), so that one bound is opened up — the 60s+ smoke in
+    `make soak-smoke` holds the real default."""
+    from retina_tpu.soak.runner import run_soak, soak_config
+
+    cfg = soak_config(
+        soak_artifact_dir=str(tmp_path),
+        soak_rss_slope_mb_per_min=10_000.0,
+    )
+    sch = [
+        SoakPhase("zipf_clean", "zipf", 3.5),
+        SoakPhase("dns_press", "dns_flood", 3.5,
+                  fault_spec="feed.backpressure:press1"),
+    ]
+    res = run_soak(cfg=cfg, schedule=sch,
+                   log=lambda m: print(m, file=sys.stderr))
+    assert res["ok"], res["sentinels"]
+    assert set(res["sentinels"]) == set(SENTINELS)
+    assert len(res["phases"]) == 2
+    assert res["events_total"] > 0
+    fault_phase = res["phases"][1]
+    assert fault_phase["recovery_seconds"] is not None
+    assert fault_phase["recovery_seconds"] <= 30.0
+    assert os.path.basename(res["artifact"]).startswith("SOAK_")
+    assert os.path.exists(res["artifact"])
+    import json
+
+    with open(res["artifact"]) as f:
+        assert json.load(f)["ok"] is True
+
+
+def test_run_soak_refuses_armed_fault_layer():
+    from retina_tpu.soak.runner import run_soak
+
+    faults.configure("transfer:raise@1")
+    try:
+        with pytest.raises(RuntimeError, match="armed"):
+            run_soak(schedule=[SoakPhase("x", "zipf", 1.0)],
+                     log=lambda m: None)
+    finally:
+        faults.clear()
