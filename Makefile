@@ -2,7 +2,8 @@
 # (.github/workflows/); the driver runs bench.py directly.
 
 .PHONY: test native bench bench-smoke soak soak-smoke distributed \
-	chaos lint analyze-device query-dryrun trace-dryrun clean
+	chaos lint analyze-device query-dryrun fleetquery-dryrun \
+	trace-dryrun clean
 
 native:
 	$(MAKE) -C retina_tpu/native
@@ -21,6 +22,13 @@ bench-smoke: native
 # -> targeted capture, with the query API under concurrent load.
 query-dryrun: native
 	python bench.py --query-dryrun
+
+# Fleet query plane + detector diversity, CI-sized: 8 simulated nodes
+# under a query storm with a mid-storm kill, plus all three builtin
+# detectors driving the closed capture loop. The 64-node headline run
+# is `python bench.py --fleetquery-dryrun` on hardware.
+fleetquery-dryrun: native
+	python bench.py --fleetquery-dryrun --smoke
 
 # Flight-recorder acceptance: the <3% overhead guard, the debug
 # endpoints, and the fleet dryrun's cross-process span-lineage check

@@ -1077,6 +1077,16 @@ def main() -> None:
                          "artifact is produced — while concurrent "
                          "scrapes (half under forced SHEDDING) hammer "
                          "the query API")
+    ap.add_argument("--fleetquery-dryrun", action="store_true",
+                    help="fleet query plane + detector diversity "
+                         "dryrun: a 1,000-query storm over 64 simulated "
+                         "nodes (10% killed mid-storm, final stretch "
+                         "under SHEDDING) must hold p99 <= 100ms with "
+                         "explicit partial coverage, AND each builtin "
+                         "detector (synflood/portscan/dnstunnel) must "
+                         "fire only on its matching regime and drive "
+                         "the closed capture loop at recall >= 0.95 "
+                         "(with --smoke: 8 nodes, 200 queries)")
     args = ap.parse_args()
     try:
         if args.soak:
@@ -1101,6 +1111,31 @@ def main() -> None:
                 bad = [k for k, v in res["sentinels"].items()
                        if not v["ok"]]
                 out["error"] = f"soak sentinels failed: {bad}"
+        elif args.fleetquery_dryrun:
+            from retina_tpu.fleetquery.dryrun import run_fleetquery_dryrun
+
+            res = run_fleetquery_dryrun(
+                nodes=8 if args.smoke else 64,
+                storm_threads=4 if args.smoke else 8,
+                storm_requests=50 if args.smoke else 125,
+                log=log,
+            )
+            n_ok = sum(1 for v in res["checks"].values() if v)
+            out = {
+                # Acceptance: every storm gate (p99, coverage, hedging,
+                # no 5xx besides explicit busy) AND every detector
+                # closed-loop gate (fire/arbitrate/recall/capture, zero
+                # benign firings) green. Headline = check pass
+                # fraction so partial failures are visible up front.
+                "metric": "fleetquery_checks_green",
+                "value": n_ok,
+                "unit": "checks",
+                "vs_baseline": round(n_ok / len(res["checks"]), 4),
+                "extra": res,
+            }
+            if not res["ok"]:
+                bad = [k for k, v in res["checks"].items() if not v]
+                out["error"] = f"fleetquery dryrun failed: {bad}"
         elif args.query_dryrun:
             from retina_tpu.timetravel.dryrun import run_query_dryrun
 

@@ -376,6 +376,30 @@ class Config:
     # Artifact sink directory (capture host_path output).
     autocapture_output_dir: str = "/tmp/retina-autocapture"
 
+    # --- fleet query plane (fleetquery/) ---
+    # Federated [t0, t1) range queries: GET /fleet/query scatter-gathers
+    # per-node ring slots (or folds the aggregator's epoch ring) into
+    # cluster-wide answers, with the node tier's bounded-latency
+    # contract plus per-node deadline / hedged retry / partial coverage.
+    fleetquery_enabled: bool = False
+    fleetquery_node_deadline_s: float = 0.25  # per-node answer budget
+    # After this long with nodes still unanswered, send ONE hedged
+    # duplicate request per straggler (tail ≠ dead).
+    fleetquery_hedge_delay_s: float = 0.05
+    fleetquery_fanout: int = 16  # scatter pool concurrency bound
+    fleetquery_cache_ttl_s: float = 1.0  # fleet result cache TTL
+    fleetquery_topk: int = 32  # default k for /fleet/query
+
+    # --- pluggable detector bank (detect/) ---
+    # Derived device-program detectors (port-scan HLL, DNS-tunnel qname
+    # entropy, SYN-flood asymmetry) over the engine's record tap; the
+    # per-window winner (priority arbitration + cooldown) feeds the
+    # same AutoCapture sink as the entropy detector.
+    detectors_enabled: bool = False
+    detector_cooldown_s: float = 60.0  # per-detector min firing spacing
+    detector_z_thresh: float = 8.0  # adaptive (EWMA z-flag) threshold
+    detector_min_windows: int = 3  # EWMA warmup before z-flags count
+
     # --- flight recorder + on-demand profiling (obs/) ---
     # Always-on span recorder over every pipeline stage
     # (docs/observability.md). Off only for A/B overhead measurement —
@@ -549,6 +573,32 @@ class Config:
                 raise ValueError(
                     f"{f} must be >= 0, got {getattr(self, f)}"
                 )
+        for f in ("fleetquery_fanout", "fleetquery_topk"):
+            if getattr(self, f) < 1:
+                raise ValueError(
+                    f"{f} must be >= 1, got {getattr(self, f)}"
+                )
+        if self.fleetquery_node_deadline_s <= 0:
+            raise ValueError(
+                f"fleetquery_node_deadline_s must be > 0, "
+                f"got {self.fleetquery_node_deadline_s}"
+            )
+        for f in ("fleetquery_hedge_delay_s", "fleetquery_cache_ttl_s",
+                  "detector_cooldown_s"):
+            if getattr(self, f) < 0:
+                raise ValueError(
+                    f"{f} must be >= 0, got {getattr(self, f)}"
+                )
+        if self.detector_z_thresh <= 0:
+            raise ValueError(
+                f"detector_z_thresh must be > 0, "
+                f"got {self.detector_z_thresh}"
+            )
+        if self.detector_min_windows < 1:
+            raise ValueError(
+                f"detector_min_windows must be >= 1, "
+                f"got {self.detector_min_windows}"
+            )
         if self.autocapture_duration_s <= 0:
             raise ValueError(
                 f"autocapture_duration_s must be > 0, "

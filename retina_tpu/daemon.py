@@ -110,7 +110,6 @@ class Daemon:
         self.autocapture = None
         if cfg.timetravel_enabled:
             from retina_tpu.timetravel.query import QueryService
-            from retina_tpu.timetravel.ring import SnapshotRing
 
             self.query_service = QueryService(
                 cfg, overload=self.cm.engine._overload
@@ -119,13 +118,15 @@ class Daemon:
                 self.query_service.add_ring(
                     self.cm.engine.timetravel_ring
                 )
-            if self.fleet_aggregator is not None:
-                fleet_ring = SnapshotRing(
-                    cfg.timetravel_ring_windows, name="fleet",
-                    supervisor=self.cm.supervisor,
+            if (
+                self.fleet_aggregator is not None
+                and self.fleet_aggregator.epoch_ring is not None
+            ):
+                # The aggregator owns its merged-epoch ring; the query
+                # tier just folds over it (RingProtocol).
+                self.query_service.add_ring(
+                    self.fleet_aggregator.epoch_ring
                 )
-                self.fleet_aggregator.timetravel_ring = fleet_ring
-                self.query_service.add_ring(fleet_ring)
             if cfg.autocapture_enabled:
                 from retina_tpu.timetravel.autocapture import AutoCapture
 
@@ -135,6 +136,48 @@ class Daemon:
                     supervisor=self.cm.supervisor,
                 )
                 self.cm.engine.anomaly_hook = self.autocapture.notify
+        # Detector bank (detect/): every registered detector judged at
+        # window close over the engine's record tap; accepted firings
+        # land in the same closed loop as the entropy hook
+        # (AutoCapture.notify) when autocapture is on.
+        self.detector_bank = None
+        if cfg.detectors_enabled:
+            from retina_tpu.detect import build_default_bank
+            from retina_tpu.fleet.shipper import window_epoch
+
+            sink = (
+                self.autocapture.notify
+                if self.autocapture is not None else None
+            )
+            self.detector_bank = build_default_bank(cfg, sink=sink)
+
+            def _record_tap(
+                records, now_s,
+                _bank=self.detector_bank, _win=cfg.window_seconds,
+            ):
+                _bank.observe(
+                    window_epoch(_win), records, now_s=float(now_s)
+                )
+
+            self.cm.engine.record_hook = _record_tap
+        # Fleet query plane (fleetquery/): cluster-wide range answers
+        # over whatever fleet sources this process has — the merged
+        # epoch ring when the aggregator role is on, plus any node
+        # clients the operator registers.
+        self.fleetquery = None
+        if cfg.fleetquery_enabled:
+            from retina_tpu.fleetquery import FleetQueryService
+
+            self.fleetquery = FleetQueryService(
+                cfg, overload=self.cm.engine._overload
+            )
+            if (
+                self.fleet_aggregator is not None
+                and self.fleet_aggregator.epoch_ring is not None
+            ):
+                self.fleetquery.add_ring(
+                    self.fleet_aggregator.epoch_ring
+                )
         if cfg.enable_hubble:
             # Hubble CP rides alongside (cmd/hubble cell graph analog):
             # plugins mirror events into the external channel; the monitor
@@ -296,6 +339,9 @@ class Daemon:
             # agent mux; registration is a dict insert, safe while the
             # server serves.
             self.query_service.attach(self.cm.server)
+        if self.fleetquery is not None and self.cm.server is not None:
+            # /fleet/query + the fleetquery debug var, same shape.
+            self.fleetquery.attach(self.cm.server)
         if self.cm.server is not None:
             # Flight-recorder debug API (obs/debug.py): GET /debug/trace
             # + POST /debug/profile, same attach shape as the query
@@ -363,6 +409,11 @@ class Daemon:
                     ring.stop()
             if self.autocapture is not None:
                 self.autocapture.stop()
+            if self.detector_bank is not None:
+                # Judge the in-progress window before the loop dies.
+                self.detector_bank.flush()
+            if self.fleetquery is not None:
+                self.fleetquery.close()
 
 
 def run_agent(

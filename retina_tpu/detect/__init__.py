@@ -1,0 +1,26 @@
+"""Pluggable detection subsystem (PSketch-style multi-detector bank).
+
+Generalizes the single entropy-burst trigger AutoCapture shipped with
+(engine harvest -> ``anomaly_hook``) into a registry of derived
+detectors, each a small device program over sketch features the
+pipeline already extracts — no new per-packet state, just new
+reductions over it:
+
+- ``portscan``   HLL of distinct dst ports per source hash-group
+- ``dnstunnel``  entropy over DNS qname lengths
+- ``synflood``   SYN:ACK asymmetry over the tcpflags families
+
+Every detector feeds the SAME closed loop: detect -> range-query the
+snapshot ring -> invertible-attribute -> targeted capture
+(timetravel/autocapture.py), arbitrated per window by priority with a
+per-detector cooldown, published as ``tpu_detector_*`` series.
+"""
+
+from retina_tpu.detect.base import (  # noqa: F401
+    Detection,
+    Detector,
+    DetectorBank,
+    build_default_bank,
+    register,
+    registered,
+)

@@ -1,0 +1,265 @@
+"""Detector registry + window-aligned multi-detector bank.
+
+A ``Detector`` accumulates host features for the current window
+(``add_records``), scores the closed window through its registered
+device program, and judges the score two ways:
+
+- **absolute**: ``score >= fire_thresh`` fires regardless of history —
+  the regimes the bank exists for (a port sweep, a tunnel, a SYN
+  flood) are categorically outside benign range, so detection must not
+  depend on how many clean windows preceded the attack;
+- **adaptive**: an ``AnomalyEWMA`` z-flag (same estimator as the
+  entropy detector) fires on drift past ``z_thresh``, floored by
+  ``min_score`` so a near-zero-variance baseline cannot convert noise
+  into a firing.
+
+The ``DetectorBank`` closes windows on epoch rollover, applies the
+per-detector cooldown, arbitrates simultaneous firings by priority
+(highest wins — the capture queue is one deep, so only one detection
+per window reaches the sink), forwards the winner to the capture sink
+(``AutoCapture.notify``), and publishes every ``tpu_detector_*``
+series.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.log import logger
+from retina_tpu.metrics import get_metrics
+from retina_tpu.ops.entropy import AnomalyEWMA
+
+_log = logger("detect")
+
+# Bank-level bound on records accumulated per window (memory guard on
+# the daemon record tap; a 1s window at millions of events would
+# otherwise buffer unbounded host copies).
+MAX_WINDOW_RECORDS = 1 << 16
+
+
+@dataclasses.dataclass(frozen=True)
+class Detection:
+    """One accepted firing, in AutoCapture.notify terms."""
+
+    detector: str
+    epoch: int
+    score: float
+    zscore: float
+    dims: tuple[str, ...]
+    priority: int
+
+
+class Detector:
+    """Base class; subclasses registered via ``@register``."""
+
+    name = "base"
+    priority = 0  # higher wins same-window arbitration
+    dims: tuple[str, ...] = ("src_ip",)  # capture-pivot dimensions
+    fire_thresh = float("inf")  # absolute firing floor
+    min_score = 0.0  # adaptive (z-path) firing floor
+
+    def __init__(
+        self,
+        z_thresh: float = 8.0,
+        min_windows: int = 3,
+        cooldown_s: float = 60.0,
+    ) -> None:
+        self.z_thresh = float(z_thresh)
+        self.min_windows = int(min_windows)
+        self.cooldown_s = float(cooldown_s)
+        self._ewma = AnomalyEWMA.zeros(1)
+        self.last_score = 0.0
+        self.last_z = 0.0
+        self.begin_window()
+
+    # -- per-window feature accumulation (host, record tap) ------------
+    def begin_window(self) -> None:
+        raise NotImplementedError
+
+    def add_records(
+        self, rec: np.ndarray, extras: Optional[dict] = None
+    ) -> None:
+        raise NotImplementedError
+
+    def score(self) -> float | None:
+        """Score the accumulated window; None = not enough signal to
+        judge (e.g. no DNS traffic for the tunnel detector) — the EWMA
+        baseline is not advanced on such windows."""
+        raise NotImplementedError
+
+    # -- judgment ------------------------------------------------------
+    def judge(self, epoch: int) -> Detection | None:
+        s = self.score()
+        if s is None:
+            return None
+        self._ewma, flags, z = self._ewma.observe(
+            jnp.asarray([s], jnp.float32),
+            z_thresh=self.z_thresh,
+            min_windows=self.min_windows,
+        )
+        self.last_score = float(s)
+        self.last_z = float(np.asarray(z)[0])
+        fired = s >= self.fire_thresh or (
+            bool(np.asarray(flags)[0]) and s >= self.min_score
+        )
+        if not fired:
+            return None
+        return Detection(
+            detector=self.name, epoch=int(epoch), score=self.last_score,
+            zscore=self.last_z, dims=self.dims, priority=self.priority,
+        )
+
+
+# -- registry ----------------------------------------------------------
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator: add a Detector subclass to the inventory.
+    Re-registering the same class is idempotent; two different classes
+    claiming one name is the same rot devprog.device_entry rejects."""
+    prev = _REGISTRY.get(cls.name)
+    if prev is not None and prev is not cls:
+        raise ValueError(
+            f"detector {cls.name!r} registered twice: "
+            f"{prev.__qualname__} and {cls.__qualname__}"
+        )
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def registered() -> dict[str, type]:
+    """The full inventory (imports the builtin detectors first, so
+    callers always see the complete set)."""
+    from retina_tpu.detect import detectors  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# -- the bank ----------------------------------------------------------
+
+class DetectorBank:
+    """Window-aligned evaluation of many detectors toward ONE sink."""
+
+    def __init__(
+        self,
+        detectors: list[Detector],
+        sink: Optional[Callable[[int, list[str]], Any]] = None,
+        enabled: bool = True,
+    ) -> None:
+        self.detectors = list(detectors)
+        self.sink = sink
+        self.enabled = enabled
+        self._epoch: int | None = None
+        self._window_rows = 0
+        self._last_fire: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self.fired: list[Detection] = []  # last accepted firings
+
+    def observe(
+        self,
+        epoch: int,
+        records: np.ndarray | None,
+        extras: Optional[dict] = None,
+        now_s: float | None = None,
+    ) -> list[Detection]:
+        """Feed one record block for window ``epoch``. Rolling to a new
+        epoch closes the previous window (score + judge + arbitrate);
+        returns the detections accepted for the closed window."""
+        with self._lock:
+            out: list[Detection] = []
+            if self._epoch is not None and epoch != self._epoch:
+                out = self._close(self._epoch, now_s)
+            if self._epoch != epoch:
+                self._epoch = int(epoch)
+                self._window_rows = 0
+                for d in self.detectors:
+                    d.begin_window()
+            if records is not None and len(records):
+                room = MAX_WINDOW_RECORDS - self._window_rows
+                if room > 0:
+                    block = records[:room]
+                    self._window_rows += len(block)
+                    for d in self.detectors:
+                        d.add_records(block, extras)
+            return out
+
+    def flush(self, now_s: float | None = None) -> list[Detection]:
+        """Close the in-progress window without starting a new one
+        (shutdown / end of a bounded feed)."""
+        with self._lock:
+            if self._epoch is None:
+                return []
+            out = self._close(self._epoch, now_s)
+            self._epoch = None
+            return out
+
+    # -- window close (under _lock) ------------------------------------
+    def _close(self, epoch: int, now_s: float | None) -> list[Detection]:
+        now = float(now_s) if now_s is not None else time.time()
+        m = get_metrics()
+        cands: list[Detection] = []
+        for d in self.detectors:
+            try:
+                det = d.judge(epoch)
+            except Exception:
+                _log.exception("detector %s failed", d.name)
+                continue
+            m.detector_score.labels(detector=d.name).set(d.last_score)
+            m.detector_zscore.labels(detector=d.name).set(d.last_z)
+            if det is None:
+                continue
+            if not self.enabled:
+                m.detector_suppressed.labels(
+                    detector=d.name, reason="disabled"
+                ).inc()
+                continue
+            last = self._last_fire.get(d.name)
+            if last is not None and (now - last) < d.cooldown_s:
+                m.detector_suppressed.labels(
+                    detector=d.name, reason="cooldown"
+                ).inc()
+                continue
+            cands.append(det)
+        if not cands:
+            return []
+        cands.sort(key=lambda c: -c.priority)
+        winner = cands[0]
+        for c in cands[1:]:
+            m.detector_suppressed.labels(
+                detector=c.detector, reason="arbitration"
+            ).inc()
+        self._last_fire[winner.detector] = now
+        m.detector_fired.labels(detector=winner.detector).inc()
+        m.detector_last_epoch.labels(detector=winner.detector).set(
+            winner.epoch
+        )
+        self.fired.append(winner)
+        del self.fired[:-16]
+        if self.sink is not None:
+            try:
+                self.sink(winner.epoch, list(winner.dims))
+            except Exception:
+                _log.exception("detector sink failed")
+        return [winner]
+
+
+def build_default_bank(
+    cfg=None, sink: Optional[Callable[[int, list[str]], Any]] = None
+) -> DetectorBank:
+    """Every registered detector at the config-driven judgment knobs."""
+    z = float(getattr(cfg, "detector_z_thresh", 8.0))
+    mw = int(getattr(cfg, "detector_min_windows", 3))
+    cd = float(getattr(cfg, "detector_cooldown_s", 60.0))
+    dets = [
+        cls(z_thresh=z, min_windows=mw, cooldown_s=cd)
+        for _, cls in sorted(registered().items())
+    ]
+    return DetectorBank(dets, sink=sink)

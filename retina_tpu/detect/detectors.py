@@ -1,0 +1,129 @@
+"""The three builtin detectors (PSketch's priority-diverse trio).
+
+Thresholds are set against the synthetic regime catalog
+(events/synthetic.py) and the fixture replays: every benign preset
+(zipf, uniform, elephant_mice — a 5-port service mix at ~5% SYN)
+scores far below each ``fire_thresh``; each matching attack regime
+(portscan sweep, dns_flood/tunnel lengths, syn_storm/ddos) scores far
+above it. tests/test_detectors.py pins both sides.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from retina_tpu.detect import features, programs
+from retina_tpu.detect.base import Detector, register
+
+
+@register
+class SynFloodDetector(Detector):
+    """SYN:ACK asymmetry over the tcpflags lanes. Highest priority:
+    a volumetric flood is the regime where capture evidence decays
+    fastest."""
+
+    name = "synflood"
+    priority = 3
+    dims = ("src_ip",)
+    fire_thresh = 3.0  # benign steady state is ~0.05 SYN per ACK
+    min_score = 1.5
+    MIN_TCP = 64.0  # packets; below this a window has no TCP story
+
+    def begin_window(self) -> None:
+        self._lanes = np.zeros((programs.SYNFLOOD_LANES,), np.float32)
+
+    def add_records(
+        self, rec: np.ndarray, extras: Optional[dict] = None
+    ) -> None:
+        if extras is not None and "tcpflag_lanes" in extras:
+            self._lanes += np.asarray(
+                extras["tcpflag_lanes"], np.float32
+            )
+        else:
+            self._lanes += features.tcpflag_lanes(rec)
+
+    def score(self) -> float | None:
+        if self._lanes[8] < self.MIN_TCP:
+            return None
+        out = np.asarray(
+            programs.synflood_program()(jnp.asarray(self._lanes))
+        )
+        return float(out[0])
+
+
+@register
+class PortScanDetector(Detector):
+    """Distinct dst ports per source hash-group (HLL bank). Benign
+    feeds touch a handful of service ports per group; a vertical sweep
+    concentrates dozens under one source's group."""
+
+    name = "portscan"
+    priority = 2
+    dims = ("dst_port",)
+    fire_thresh = 12.0  # benign mixes peak ~5 ports/group; sweeps >= 24
+    min_score = 8.0
+
+    def begin_window(self) -> None:
+        self._blocks: list[np.ndarray] = []
+
+    def add_records(
+        self, rec: np.ndarray, extras: Optional[dict] = None
+    ) -> None:
+        self._blocks.append(np.asarray(rec))
+
+    def score(self) -> float | None:
+        if not self._blocks:
+            return None
+        rec = (
+            self._blocks[0] if len(self._blocks) == 1
+            else np.concatenate(self._blocks)
+        )
+        if not len(rec):
+            return None
+        keys, w = features.padded_flow_keys(rec)
+        fn = programs.portscan_program(
+            len(keys), programs.PORTSCAN_GROUPS,
+            programs.PORTSCAN_PRECISION, programs.PORTSCAN_SEED,
+        )
+        est = np.asarray(fn(jnp.asarray(keys), jnp.asarray(w)))
+        return float(est.max())
+
+
+@register
+class DnsTunnelDetector(Detector):
+    """Entropy over qname lengths. Features come from the F.DNS low
+    byte on the record tap, or from the dns plugin's live string table
+    (``extras["qname_hist"]``, DnsPlugin.qname_length_hist) when the
+    daemon runs the real qname path."""
+
+    name = "dnstunnel"
+    priority = 1
+    dims = ("src_ip",)
+    fire_thresh = 4.2  # benign lengths cluster in <= 9 bins (< 3.2 bits)
+    min_score = 3.6
+    MIN_DNS = 32.0  # queries; below this the histogram is noise
+
+    def begin_window(self) -> None:
+        self._hist = np.zeros((1, programs.DNSTUNNEL_BINS), np.float32)
+
+    def add_records(
+        self, rec: np.ndarray, extras: Optional[dict] = None
+    ) -> None:
+        if extras is not None and "qname_hist" in extras:
+            self._hist = self._hist + np.asarray(
+                extras["qname_hist"], np.float32
+            ).reshape(1, -1)
+        else:
+            self._hist = self._hist + features.qname_length_hist(rec)
+
+    def score(self) -> float | None:
+        if float(self._hist.sum()) < self.MIN_DNS:
+            return None
+        fn = programs.dnstunnel_program(
+            self._hist.shape[1], programs.DNSTUNNEL_SEED
+        )
+        out = np.asarray(fn(jnp.asarray(self._hist)))
+        return float(out[0])

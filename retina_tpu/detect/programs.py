@@ -1,0 +1,125 @@
+"""Detector device programs — derived reductions over existing ops.
+
+Each detector's scoring kernel is a registered device entry
+(devprog.py) so the RT300-RT305 device pass lowers and audits it like
+every other program: the portscan program is an HLL bank keyed by
+source hash-group, the dnstunnel program is the plug-in entropy of a
+qname-length histogram, the synflood program is a flag-asymmetry
+ratio over the tcpflags count lanes. All three are cached jit builders
+(the fold.py idiom): one compile per static signature, reused across
+windows.
+
+Inputs are tiny host-built feature arrays (detect/features.py), so the
+programs cost microseconds — the point is that the SCORING algebra is
+in the audited inventory, not that it needs a big accelerator.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from retina_tpu.devprog import device_entry
+from retina_tpu.ops.entropy import EntropyWindow
+from retina_tpu.ops.hyperloglog import HyperLogLog
+
+# Portscan: sources are folded into this many hash-groups, each group
+# an HLL of the distinct dst ports its sources probed. Precision 8
+# (256 registers) bounds the estimate error well under the decision
+# margin (benign feeds touch a handful of service ports; a sweep
+# touches dozens).
+PORTSCAN_GROUPS = 32
+PORTSCAN_PRECISION = 8
+PORTSCAN_SEED = 0x5CA7
+
+# DNS tunneling: qname lengths bucketed 0..63 (labels >255B are
+# rejected at parse time; 64 bins covers the exfil-relevant range).
+DNSTUNNEL_BINS = 64
+DNSTUNNEL_SEED = 0xD25
+
+# Synflood input: 8 per-flag-bit packet counts (index = TCP flag bit
+# position, schema.py TCP_*) + total TCP packets in lane 8.
+SYNFLOOD_LANES = 9
+
+_PORTSCAN_CACHE: dict[Any, Any] = {}
+_DNSTUNNEL_CACHE: dict[Any, Any] = {}
+_SYNFLOOD_CACHE: dict[Any, Any] = {}
+
+
+@device_entry("detect.portscan", kind="jit")
+def portscan_program(n: int, groups: int, precision: int, seed: int):
+    """Jitted scan scorer: (keys (N,4) u32, weights (N,) f32) ->
+    (G,) distinct-dst-port estimates per source hash-group.
+
+    Group = multiplicative hash of src ip — a single scanning source
+    lands in ONE group, so its probe breadth is not diluted across the
+    bank; benign groups aggregate a few sources sharing a few service
+    ports. Zero-weight rows (padding) are masked out of the HLL."""
+    key = (n, groups, precision, seed)
+    fn = _PORTSCAN_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def run(keys, weights):
+        src = keys[:, 0]
+        dport = keys[:, 3]
+        group = (src * jnp.uint32(2654435761)) % jnp.uint32(groups)
+        hll = HyperLogLog.zeros(groups, precision, seed=seed)
+        hll = hll.update([dport], group, weights > 0)
+        return hll.estimate()
+
+    fn = jax.jit(run)
+    _PORTSCAN_CACHE[key] = fn
+    return fn
+
+
+@device_entry("detect.dnstunnel", kind="jit")
+def dnstunnel_program(nbins: int, seed: int):
+    """Jitted tunnel scorer: (hist (1, nbins) f32 qname-length
+    histogram) -> (2,) [entropy_bits, total_queries].
+
+    Benign qnames cluster in a narrow length band (low entropy);
+    tunneled payloads spread toward the label-length ceiling (high
+    entropy) — the Sketchy/PSketch exfil signature."""
+    key = (nbins, seed)
+    fn = _DNSTUNNEL_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def run(hist):
+        bits = EntropyWindow(counts=hist, seed=seed).entropy_bits()
+        return jnp.stack([bits[0], jnp.sum(hist)])
+
+    fn = jax.jit(run)
+    _DNSTUNNEL_CACHE[key] = fn
+    return fn
+
+
+@device_entry("detect.synflood", kind="jit")
+def synflood_program():
+    """Jitted flood scorer: (lanes (9,) f32 tcpflag counts) ->
+    (3,) [syn/ack ratio, syn fraction, syn count].
+
+    A healthy TCP mix acknowledges what it opens (ratio << 1 per the
+    ~1 SYN : many ACK steady state); a half-open flood inverts the
+    asymmetry. Denominators floor at 1 so an all-SYN window scores by
+    raw SYN volume instead of dividing by zero."""
+    fn = _SYNFLOOD_CACHE.get(0)
+    if fn is not None:
+        return fn
+
+    def run(lanes):
+        syn = lanes[1]  # TCP_SYN = 1 << 1
+        ack = lanes[4]  # TCP_ACK = 1 << 4
+        total = lanes[8]
+        return jnp.stack([
+            syn / jnp.maximum(ack, 1.0),
+            syn / jnp.maximum(total, 1.0),
+            syn,
+        ])
+
+    fn = jax.jit(run)
+    _SYNFLOOD_CACHE[0] = fn
+    return fn

@@ -72,6 +72,7 @@ ENTRY_MODULES = (
     "retina_tpu.engine",
     "retina_tpu.fleet.aggregator",
     "retina_tpu.timetravel.fold",
+    "retina_tpu.detect.programs",
 )
 
 

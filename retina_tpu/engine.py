@@ -335,6 +335,16 @@ class SketchEngine:
         # thread when the entropy detector flags a window (must never
         # block — notify only enqueues).
         self.anomaly_hook: Any = None
+        # Record tap (detect/base.py DetectorBank.observe): sees every
+        # record block on the ingest path before partitioning — in
+        # _build_quantum post-combine on the live feed (inline flush
+        # AND feed workers; the bank serializes internally), and in
+        # _dispatch for direct callers (step_records, recovery probe).
+        # The two sites are disjoint, so no block is tapped twice.
+        # Must stay cheap — the bank does vectorized feature folds
+        # only; scoring happens at window close. Pre-overload-sampling
+        # so detectors judge the full signal, not the sampled residue.
+        self.record_hook: Any = None
         # Protected close lane: window ticks acquire THIS semaphore,
         # never the step in-flight one — a saturated step pipeline can
         # delay a close behind queued transfers but can never starve it
@@ -992,6 +1002,11 @@ class SketchEngine:
         self, records: np.ndarray, now_s: int,
         record_metrics: bool = True,
     ) -> None:
+        if self.record_hook is not None:
+            try:
+                self.record_hook(records, now_s)
+            except Exception:
+                self._count_error("record_hook")
         sb = partition_events(
             records, self.n_devices, self.cfg.batch_capacity,
             min_bucket=self.cfg.transfer_min_bucket,
@@ -2402,6 +2417,11 @@ class SketchEngine:
             mnames.STAGE_COMBINE, t_cb0,
             fleet_epoch(self.cfg.window_seconds),
         )
+        if self.record_hook is not None:
+            try:
+                self.record_hook(all_rec, now_s)
+            except Exception:
+                self._count_error("record_hook")
         # Overload sampling sits POST-combine / PRE-partition: a row's
         # packet weight is final here, so the device step can recompute
         # the same exemption predicate over the same rows and rescale

@@ -71,12 +71,20 @@ PRESETS: dict[str, dict[str, float | str]] = {
                   "drop_fraction": 0.15},
     "conntrack_churn": {"mode": "conntrack_churn", "zipf_a": 1.05},
     "elephant_mice": {"mode": "elephant_mice", "zipf_a": 2.0},
+    # Vertical port sweep: a handful of scanner sources probe many
+    # dst ports on one victim (detect.portscan's matching regime).
+    "portscan": {"mode": "portscan", "zipf_a": 1.2},
+    # Banked-capture replay: batches come from the real pcap fixtures
+    # under tests/fixtures/real via sources/pcapreplay.py (timestamp
+    # rebasing per pass) instead of the synthetic sampler — realistic
+    # negatives for the detector bank, real byte-stream provenance.
+    "pcap_replay": {"mode": "pcap_replay"},
 }
 
 # Legal TrafficGen.mode values ("mix" is the default mixed TCP/UDP
 # forward/drop/DNS blend the original generator produced).
 MODES = ("mix", "dns_flood", "syn_storm", "conntrack_churn",
-         "elephant_mice")
+         "elephant_mice", "portscan", "pcap_replay")
 
 
 def preset_params(name: str) -> dict[str, float | str]:
@@ -109,9 +117,13 @@ class TrafficGen:
     dns_fraction: float = 0.01
     # Batch-shaping regime (MODES): "mix" is the classic blend; the
     # named attack/churn regimes reshape each batch after the base
-    # sampling pass (see _shape_regime).
+    # sampling pass (see _shape_regime); "pcap_replay" bypasses the
+    # sampler and serves rebased passes over the banked captures.
     mode: str = "mix"
     seed: int = 0
+    # pcap_replay inputs; empty = the repo's banked fixtures
+    # (tests/fixtures/real/*.pcap).
+    pcap_paths: tuple[str, ...] = ()
 
     def __post_init__(self):
         if self.mode not in MODES:
@@ -119,6 +131,8 @@ class TrafficGen:
                 f"TrafficGen mode must be one of {MODES}, "
                 f"got {self.mode!r}"
             )
+        if self.mode == "pcap_replay":
+            self._init_replay()
         rng = np.random.default_rng(self.seed)
         n = self.n_flows
         self.src_pod = rng.integers(1, self.n_pods, n).astype(np.uint32)
@@ -139,9 +153,63 @@ class TrafficGen:
         self._counts = np.zeros(n, np.int64)
         self._now_ns = 1_700_000_000 * 1_000_000_000
 
+    # -- pcap replay (mode="pcap_replay") ------------------------------
+    def _init_replay(self) -> None:
+        """Decode the banked captures once; batches then come from
+        looping, timestamp-rebased passes (sources/pcapreplay.py)."""
+        import pathlib
+
+        from retina_tpu.sources.pcapreplay import (
+            PcapReplaySource, safe_decode_bytes,
+        )
+
+        paths = [pathlib.Path(p) for p in self.pcap_paths]
+        if not paths:
+            fixture_dir = (
+                pathlib.Path(__file__).resolve().parents[2]
+                / "tests" / "fixtures" / "real"
+            )
+            paths = sorted(fixture_dir.glob("*.pcap"))
+        blocks = []
+        for p in paths:
+            dec = safe_decode_bytes(p.read_bytes())
+            if len(dec.result.records):
+                blocks.append(dec.result.records)
+        if not blocks:
+            raise ValueError(
+                "pcap_replay: no decodable records in "
+                + (", ".join(str(p) for p in paths) or "<no files>")
+            )
+        self._replay_src = PcapReplaySource(np.concatenate(blocks))
+        self._replay_blocks = self._replay_src.blocks()
+        self._replay_buf = np.zeros((0, NUM_FIELDS), np.uint32)
+        self._replay_pos = 0
+
+    def _replay_batch(self, n_events: int) -> np.ndarray:
+        out = []
+        have = 0
+        while have < n_events:
+            if self._replay_pos >= len(self._replay_buf):
+                blk = next(self._replay_blocks, None)
+                if blk is None:  # pass done -> next rebased pass
+                    self._replay_blocks = self._replay_src.blocks()
+                    blk = next(self._replay_blocks)
+                self._replay_buf, self._replay_pos = blk, 0
+            take = min(
+                n_events - have, len(self._replay_buf) - self._replay_pos
+            )
+            out.append(
+                self._replay_buf[self._replay_pos:self._replay_pos + take]
+            )
+            self._replay_pos += take
+            have += take
+        return np.concatenate(out).astype(np.uint32)
+
     # ------------------------------------------------------------------
     def batch(self, n_events: int) -> np.ndarray:
         """Generate (n_events, NUM_FIELDS) uint32 records."""
+        if self.mode == "pcap_replay":
+            return self._replay_batch(n_events)
         rng = self._rng
         fid = rng.choice(self.n_flows, n_events, p=self.flow_probs)
         np.add.at(self._counts, fid, 1)
@@ -187,7 +255,13 @@ class TrafficGen:
             is_resp[is_dns], EV_DNS_RESP, EV_DNS_REQ
         ).astype(np.uint32)
         qtype = rng.choice(np.array([1, 28, 5], np.uint32), n_events)
-        rec[is_dns, F.DNS] = (qtype[is_dns] << np.uint32(16)).astype(np.uint32)
+        # F.DNS low byte carries the qname length (schema leaves it
+        # free: qtype<<16 | rcode<<8 | len). Benign names cluster in a
+        # narrow 8..16 band — the detect.dnstunnel baseline.
+        qlen = rng.integers(8, 17, n_events).astype(np.uint32)
+        rec[is_dns, F.DNS] = (
+            (qtype[is_dns] << np.uint32(16)) | qlen[is_dns]
+        ).astype(np.uint32)
         rec[is_dns, F.DNS_QHASH] = (fid[is_dns] & 0xFFFF).astype(np.uint32)
         return self._shape_regime(rec, fid)
 
@@ -220,6 +294,15 @@ class TrafficGen:
             rec[is_dns, F.BYTES] = rng.integers(
                 64, 140, int(is_dns.sum())
             ).astype(np.uint32)
+            # Encoded-payload qnames: lengths spread toward the label
+            # ceiling instead of the benign 8..16 cluster — the
+            # detect.dnstunnel entropy signature.
+            qlen = rng.integers(24, 64, int(is_dns.sum())).astype(
+                np.uint32
+            )
+            rec[is_dns, F.DNS] = (
+                rec[is_dns, F.DNS] & np.uint32(0xFFFFFF00)
+            ) | qlen
         elif self.mode == "syn_storm":
             # Half-open flood: most rows become 64-byte TCP SYNs from
             # spoofed (non-pod) sources onto a few victim pods —
@@ -253,6 +336,27 @@ class TrafficGen:
             rec[syn, F.META] = (
                 rec[syn, F.META] & np.uint32(0xFF00FFFF)
             ) | (np.uint32(TCP_SYN) << np.uint32(16))
+        elif self.mode == "portscan":
+            # Vertical sweep: most rows become SYN probes from a few
+            # scanner sources walking dst ports 1..1024 on one victim
+            # — per-source distinct-dst-port counts explode while the
+            # remaining mix keeps the benign floor visible.
+            scan = rng.random(n) < 0.6
+            ns = int(scan.sum())
+            scanners = (np.uint32(0xC9000000) + (fid % 4).astype(
+                np.uint32
+            ))
+            rec[scan, F.SRC_IP] = scanners[scan]
+            rec[scan, F.DST_IP] = pod_ip(1)
+            sweep = rng.integers(1, 1025, ns).astype(np.uint32)
+            rec[scan, F.PORTS] = (np.uint32(40000) << np.uint32(16)) | sweep
+            rec[scan, F.META] = (
+                (np.uint32(PROTO_TCP) << np.uint32(24))
+                | (np.uint32(TCP_SYN) << np.uint32(16))
+                | (np.uint32(OP_FROM_NETWORK) << np.uint32(8))
+                | (np.uint32(DIR_INGRESS) << np.uint32(4))
+            )
+            rec[scan, F.BYTES] = 64
         elif self.mode == "elephant_mice":
             # Bimodal sizes: the steep-Zipf head flows carry MTU-sized
             # frames while the mouse tail sends minimum-size ones —
@@ -307,4 +411,77 @@ class TrafficGen:
         rec[:, F.PACKETS] = 1
         rec[:, F.VERDICT] = VERDICT_FORWARDED
         rec[:, F.EVENT_TYPE] = EV_FORWARD
+        return rec
+
+    def portscan_batch(
+        self,
+        n_events: int,
+        target_pod: int = 1,
+        n_scanners: int = 4,
+        n_ports: int = 24,
+    ) -> np.ndarray:
+        """A vertical port sweep with ATTRIBUTABLE ground truth: few
+        scanner sources × few probed ports = few distinct flow keys,
+        each heavy enough for invertible decode, while per-source
+        distinct dst ports spike (detect.portscan's signature)."""
+        rng = self._rng
+        rec = np.zeros((n_events, NUM_FIELDS), np.uint32)
+        ts = self._now_ns + np.arange(n_events, dtype=np.int64) * 100
+        self._now_ns = int(ts[-1]) + 100
+        rec[:, F.TS_LO] = (ts & 0xFFFFFFFF).astype(np.uint32)
+        rec[:, F.TS_HI] = (ts >> 32).astype(np.uint32)
+        scanner = rng.integers(0, n_scanners, n_events).astype(np.uint32)
+        rec[:, F.SRC_IP] = np.uint32(0xC9000000) + scanner
+        rec[:, F.DST_IP] = pod_ip(target_pod)
+        port = (1 + rng.integers(0, n_ports, n_events)).astype(np.uint32)
+        rec[:, F.PORTS] = (np.uint32(40000) << np.uint32(16)) | port
+        rec[:, F.META] = (
+            (np.uint32(PROTO_TCP) << np.uint32(24))
+            | (np.uint32(TCP_SYN) << np.uint32(16))
+            | (np.uint32(OP_FROM_NETWORK) << np.uint32(8))
+            | (np.uint32(DIR_INGRESS) << np.uint32(4))
+        )
+        rec[:, F.BYTES] = 64
+        rec[:, F.PACKETS] = 1
+        rec[:, F.VERDICT] = VERDICT_FORWARDED
+        rec[:, F.EVENT_TYPE] = EV_FORWARD
+        return rec
+
+    def tunnel_batch(
+        self,
+        n_events: int,
+        resolver_pod: int = 2,
+        n_clients: int = 48,
+    ) -> np.ndarray:
+        """DNS exfiltration with attributable ground truth: clients
+        stream TXT queries with long, varied qname lengths at one
+        resolver — (client, resolver, UDP, 53) keys are few and heavy
+        while qname-length entropy spikes (detect.dnstunnel)."""
+        rng = self._rng
+        rec = np.zeros((n_events, NUM_FIELDS), np.uint32)
+        ts = self._now_ns + np.arange(n_events, dtype=np.int64) * 100
+        self._now_ns = int(ts[-1]) + 100
+        rec[:, F.TS_LO] = (ts & 0xFFFFFFFF).astype(np.uint32)
+        rec[:, F.TS_HI] = (ts >> 32).astype(np.uint32)
+        client = rng.integers(0, n_clients, n_events).astype(np.uint32)
+        rec[:, F.SRC_IP] = np.uint32(0xCA000000) + client
+        rec[:, F.DST_IP] = pod_ip(resolver_pod)
+        eph = rng.integers(1024, 65536, n_events).astype(np.uint32)
+        rec[:, F.PORTS] = (eph << np.uint32(16)) | np.uint32(53)
+        rec[:, F.META] = (
+            (np.uint32(PROTO_UDP) << np.uint32(24))
+            | (np.uint32(OP_FROM_NETWORK) << np.uint32(8))
+            | (np.uint32(DIR_INGRESS) << np.uint32(4))
+        )
+        qlen = rng.integers(24, 64, n_events).astype(np.uint32)
+        rec[:, F.DNS] = (np.uint32(16) << np.uint32(16)) | qlen  # TXT
+        rec[:, F.DNS_QHASH] = rng.integers(
+            0, 1 << 16, n_events
+        ).astype(np.uint32)
+        rec[:, F.BYTES] = rng.integers(100, 300, n_events).astype(
+            np.uint32
+        )
+        rec[:, F.PACKETS] = 1
+        rec[:, F.VERDICT] = VERDICT_FORWARDED
+        rec[:, F.EVENT_TYPE] = EV_DNS_REQ
         return rec

@@ -90,11 +90,21 @@ class FleetAggregator:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._sub_id: str | None = None
-        # Time-travel ring for merged epochs (timetravel/ring.py), set
-        # by the daemon when timetravel_enabled: each merged epoch's
-        # arrays are retained as a fleet-ring slot so range queries
-        # cover cluster history, not just this node's.
-        self.timetravel_ring: Any = None
+        # Merged-epoch history ring (timetravel/ring.py RingProtocol):
+        # the aggregator OWNS its epoch ring — each merged epoch's
+        # arrays are retained as a slot, so range queries (node tier and
+        # the fleet query plane) cover cluster history, not just this
+        # node's. Created here, not by the daemon: the ring is part of
+        # the aggregator's state, and it exposes the exact
+        # select/span/stats surface of the engine's SnapshotRing.
+        self.epoch_ring: Any = None
+        if getattr(cfg, "timetravel_enabled", False):
+            from retina_tpu.timetravel.ring import SnapshotRing
+
+            self.epoch_ring = SnapshotRing(
+                cfg.timetravel_ring_windows, name="fleet",
+                supervisor=supervisor,
+            )
         # Rolling window of recent rollups for tests/dryrun/debug vars.
         self.rollups: list[dict] = []
         self.epochs_merged = 0
@@ -103,6 +113,16 @@ class FleetAggregator:
         # eviction never had to force-close an epoch (dryrun asserts
         # this at 100-agent scale).
         self.open_buckets_max = 0
+
+    # Back-compat alias: older wiring (daemon, tests) reached the ring
+    # as ``timetravel_ring``; both names see the same object.
+    @property
+    def timetravel_ring(self) -> Any:
+        return self.epoch_ring
+
+    @timetravel_ring.setter
+    def timetravel_ring(self, ring: Any) -> None:
+        self.epoch_ring = ring
 
     # -- lifecycle -----------------------------------------------------
     def start(self, subscribe: bool = True) -> None:

@@ -1,0 +1,22 @@
+"""fleetquery: federated time-travel range queries over the fleet.
+
+The node tier (timetravel/) answers ``[t0, t1)`` range queries over one
+process's snapshot rings. This package lifts the same contract to the
+cluster: ``GET /fleet/query`` scatter-gathers per-node ring slots (or
+folds the aggregator's merged-epoch ring when this process IS the
+aggregator), merges them with the SAME RFLT semilattice fold the fleet
+rollup uses — sketches merge across nodes exactly as they merge across
+time — and answers cluster-wide top-k / cardinality / entropy with an
+explicit coverage annotation (``nodes_answered / nodes_total``) when
+part of the fleet misses its deadline.
+
+The bounded-latency contract is the node tier's, verbatim: one fold in
+flight, TTL result cache, serve-stale or 503-busy, immutable ranges
+cached forever, SHEDDING never initiates a scatter. Fan-out adds the
+federation knobs on top: per-node deadline, hedged retry after a quiet
+delay, partial answers over whoever made it.
+"""
+
+from retina_tpu.fleetquery.service import FleetQueryService, LocalNodeClient
+
+__all__ = ["FleetQueryService", "LocalNodeClient"]

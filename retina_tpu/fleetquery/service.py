@@ -1,0 +1,466 @@
+"""Fleet-level federated range queries (``GET /fleet/query``).
+
+Query params are the node tier's (timetravel/query.py): ``t0``/``t1``
+window-epoch range or ``last=N``, plus ``k`` and ``fam``. The answer is
+the node tier's doc shape plus a ``coverage`` block::
+
+    {"coverage": {"nodes_answered": 58, "nodes_total": 64,
+                  "partial": true}, ...}
+
+Latency contract (inherited verbatim from PR 10's node tier — the
+thing tests/test_fleetquery.py and the dryrun p99 gate pin): handler
+threads NEVER queue behind a fold or a scatter. One gather+fold runs at
+a time (non-blocking single-flight); concurrent requests serve from the
+TTL result cache — stale if need be — or answer ``busy`` immediately.
+Ranges ending at or before the fleet's newest known epoch are immutable
+and key with a zero edge token (stable cache key). Under SHEDDING no
+scatter is ever initiated: any cached result serves (TTL ignored),
+everything else is ``busy`` — backing off the whole fleet exactly when
+this node is shedding its own load.
+
+Fan-out mechanics: every node is asked once on a shared bounded pool;
+after ``fleetquery_hedge_delay_s`` of quiet, unfinished nodes get ONE
+hedged duplicate request; whatever lands by
+``fleetquery_node_deadline_s`` merges, everyone else is counted in
+``fleet_query_node_errors`` and the answer ships partial.
+
+Federation splits the fold in two, leaning on the RFLT semilattice
+(fold.py: every per-array op associative + commutative): each NODE
+folds its own span slots locally and ships one merged snapshot — the
+same bytes-on-the-wire argument as the fleet shipper, and node folds
+run in parallel across the scatter pool — then this service folds the
+node snapshots in fixed-size chunks (``_fold_many``). Chunking keeps
+every jit signature in ``{2..FOLD_CHUNK}`` no matter the fan-out or how
+many nodes answered, so a mid-storm node kill never triggers a
+recompile on the query path.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import threading
+import time
+from typing import Any
+
+from retina_tpu.fleet.aggregator import format_key
+from retina_tpu.log import logger, rate_limited
+from retina_tpu.metrics import get_metrics
+from retina_tpu.runtime.overload import SHEDDING
+from retina_tpu.timetravel.fold import (
+    RangeFold, range_decode, range_extract, range_topk, set_aot_cache_dir,
+)
+from retina_tpu.timetravel.ring import RingProtocol
+
+_JSON = "application/json"
+
+# Max operands per fold call in the cluster merge (see module
+# docstring: bounds jit signatures under any fan-out / answer count).
+FOLD_CHUNK = 8
+
+
+def _reply(code: int, doc: dict) -> tuple[int, bytes, str]:
+    return code, json.dumps(doc, default=str).encode(), _JSON
+
+
+class LocalNodeClient:
+    """A fleet member reachable in-process: one snapshot ring + the
+    node-side span fold behind the NodeClient surface
+    (``query(e0, e1, deadline_s)`` -> answer dict or None). The dryrun
+    and tests build fleets of these; a transport-backed client (gRPC /
+    relay) answers the same shape::
+
+        {"node": str, "epochs": [int, ...], "window_s": float,
+         "seeds": {...}, "arrays": {name: ndarray} | None}
+
+    ``arrays`` is the node's span-folded snapshot (None when the range
+    is empty there). Immutable spans are cached per ring generation, so
+    a repeat query is a dict hit — exactly what a real node's own query
+    tier would serve.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        ring: RingProtocol,
+        fold: RangeFold,
+        latency_s: float = 0.0,
+    ) -> None:
+        self.name = name
+        self.ring = ring
+        self.fold = fold
+        self.latency_s = float(latency_s)
+        self.dead = False  # harness kill switch (simulated node loss)
+        self.calls = 0
+        self._cache: dict[Any, dict] = {}
+
+    def query(
+        self, e0: int, e1: int, deadline_s: float
+    ) -> dict[str, Any] | None:
+        self.calls += 1
+        if self.dead:
+            return None
+        if self.latency_s > 0:
+            time.sleep(self.latency_s)
+        if self.dead:  # died while "on the wire"
+            return None
+        key = (int(e0), int(e1), self.ring.appended)
+        hit = self._cache.get(key)
+        if hit is not None:
+            return dict(hit)
+        slots = self.ring.select(e0, e1)
+        if not slots:
+            ans: dict[str, Any] = {
+                "node": self.name, "epochs": [], "window_s": 0.0,
+                "seeds": {}, "arrays": None,
+            }
+        else:
+            seeds = slots[0][3]
+            arrays = (
+                slots[0][1] if len(slots) == 1
+                else self.fold.fold([s[1] for s in slots], seeds)
+            )
+            ans = {
+                "node": self.name,
+                "epochs": [s[0] for s in slots],
+                "window_s": slots[0][2],
+                "seeds": dict(seeds),
+                "arrays": arrays,
+            }
+        self._cache[key] = ans
+        while len(self._cache) > 32:
+            self._cache.pop(next(iter(self._cache)))
+        return dict(ans)
+
+
+class FleetQueryService:
+    """One per daemon; owns the scatter pool, the fold jit cache and
+    the fleet-level result cache."""
+
+    def __init__(self, cfg, overload=None, fold: RangeFold | None = None):
+        self.cfg = cfg
+        self.log = logger("fleetquery")
+        self._overload = overload
+        # Fleet folds share the engine's AOT disk cache like the node
+        # query tier does (restart cost, BENCH_r06).
+        set_aot_cache_dir(getattr(cfg, "aot_cache_dir", ""))
+        self.fold = fold or RangeFold()
+        self.clients: list[Any] = []
+        self.ring: RingProtocol | None = None  # aggregator epoch ring
+        # (e0, e1, k, fam, edge) -> (monotonic_t, result doc)
+        self._cache: dict[Any, tuple[float, dict]] = {}
+        self._cache_lock = threading.Lock()
+        self._flight = threading.Lock()
+        self._pool: concurrent.futures.ThreadPoolExecutor | None = None
+        self._pool_lock = threading.Lock()
+        # Live-edge token: bumped whenever new fleet epochs may exist
+        # (note_append, or a gather that saw a newer epoch). Ranges
+        # ending at or before the last known newest epoch key with
+        # edge 0 — a stable key, like the node tier's immutable ranges.
+        self._edge = 0
+        self._newest = -1
+        self.queries = 0
+        self.hedges = 0
+        self.node_errors: dict[str, int] = {}
+
+    # -- wiring --------------------------------------------------------
+    def add_client(self, client: Any) -> None:
+        """Register one fleet member (NodeClient surface)."""
+        self.clients.append(client)
+
+    def add_ring(self, ring: RingProtocol) -> None:
+        """Aggregator-resident mode: no scatter, fold the merged-epoch
+        ring directly (every epoch there is already cluster-merged)."""
+        self.ring = ring
+
+    def note_append(self) -> None:
+        """Signal that new fleet epochs may have landed (aggregator
+        merge tick / shipper close). Invalidates live-edge cache keys."""
+        self._edge += 1
+
+    def attach(self, server) -> None:
+        server.register_route("/fleet/query", self.handle)
+        server.expose_var("fleetquery", self.stats)
+
+    def close(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def stats(self) -> dict:
+        return {
+            "clients": len(self.clients),
+            "dead_clients": sum(
+                1 for c in self.clients if getattr(c, "dead", False)
+            ),
+            "ring": self.ring.name if self.ring is not None else None,
+            "queries": self.queries,
+            "hedges": self.hedges,
+            "node_errors": dict(self.node_errors),
+            "newest_epoch": self._newest,
+            "cache_entries": len(self._cache),
+        }
+
+    # -- HTTP entry (handler threads; must bound latency) --------------
+    def handle(self, q: dict) -> tuple[int, bytes, str]:
+        m = get_metrics()
+        t0 = time.monotonic()
+        status = "error"
+        try:
+            code, doc, status = self._handle(q)
+            return _reply(code, doc)
+        except Exception:
+            if rate_limited("fleetquery"):
+                self.log.exception("fleet query failed")
+            return _reply(500, {"error": "internal"})
+        finally:
+            m.fleet_query_seconds.observe(time.monotonic() - t0)
+            m.fleet_query_requests.labels(status=status).inc()
+            self.queries += 1
+
+    def _handle(self, q: dict) -> tuple[int, dict, str]:
+        if not self.clients and self.ring is None:
+            return 404, {"error": "no fleet sources attached"}, "bad_request"
+        newest = self._newest
+        if self.ring is not None and not self.clients:
+            _, newest = self.ring.span()
+        if "last" in q:
+            if newest < 0:
+                return 400, {
+                    "error": "fleet span unknown yet; use t0+t1"
+                }, "bad_request"
+            n = max(1, int(q["last"][0]))
+            e0, e1 = newest - n + 1, newest + 1
+        else:
+            try:
+                e0 = int(q["t0"][0])
+                e1 = int(q["t1"][0])
+            except (KeyError, ValueError, IndexError):
+                return 400, {"error": "need t0+t1 (window epochs) "
+                             "or last=N"}, "bad_request"
+        if e1 <= e0:
+            return 400, {"error": "empty range: t1 <= t0"}, "bad_request"
+        k = int(q.get("k", [self.cfg.fleetquery_topk])[0])
+        fam = q.get("fam", ["flow"])[0]
+        return self._query_cached(e0, e1, k, fam)
+
+    # -- cached + single-flight gather/fold ----------------------------
+    def _query_cached(
+        self, e0: int, e1: int, k: int, fam: str
+    ) -> tuple[int, dict, str]:
+        ov = self._overload
+        shedding = ov is not None and ov.state >= SHEDDING
+        edge = self._edge if (self._newest < 0 or e1 > self._newest) else 0
+        key = (e0, e1, k, fam, edge)
+        ttl = float(self.cfg.fleetquery_cache_ttl_s)
+        now = time.monotonic()
+        with self._cache_lock:
+            hit = self._cache.get(key)
+        if hit is not None and (shedding or now - hit[0] < ttl):
+            doc = dict(hit[1])
+            if shedding and now - hit[0] >= ttl:
+                doc["stale"] = True
+            status = "stale" if doc.get("stale") else (
+                "partial" if doc.get("coverage", {}).get("partial")
+                else "ok"
+            )
+            return 200, doc, status
+        if shedding:
+            # Shedding: NEVER start a fleet scatter — a cluster-wide
+            # fan-out is exactly the load this node must not add while
+            # it is dropping its own. Any cached doc already served
+            # above; with nothing cached, back off.
+            if hit is not None:
+                doc = dict(hit[1])
+                doc["stale"] = True
+                return 200, doc, "stale"
+            return 503, {"error": "busy", "retry": True}, "busy"
+        if not self._flight.acquire(blocking=False):
+            if hit is not None:
+                doc = dict(hit[1])
+                doc["stale"] = True
+                return 200, doc, "stale"
+            return 503, {"error": "busy", "retry": True}, "busy"
+        try:
+            code, doc, status = self._query(e0, e1, k, fam)
+            if code == 200:
+                with self._cache_lock:
+                    self._cache[key] = (time.monotonic(), doc)
+                    while len(self._cache) > 128:
+                        self._cache.pop(next(iter(self._cache)))
+            return code, doc, status
+        finally:
+            self._flight.release()
+
+    # -- the actual federated query (single flight) --------------------
+    def _query(
+        self, e0: int, e1: int, k: int, fam: str
+    ) -> tuple[int, dict, str]:
+        m = get_metrics()
+        if self.clients:
+            results = self._scatter(e0, e1)
+            total = len(self.clients)
+        else:
+            assert self.ring is not None
+            slots = self.ring.select(e0, e1)
+            results = [{
+                "node": self.ring.name,
+                "epochs": [s[0] for s in slots],
+                "window_s": slots[0][2] if slots else 0.0,
+                "seeds": dict(slots[0][3]) if slots else {},
+                "arrays": (
+                    None if not slots else
+                    slots[0][1] if len(slots) == 1 else
+                    self.fold.fold([s[1] for s in slots], slots[0][3])
+                ),
+            }]
+            total = 1
+        answered = len(results)
+        m.fleet_query_nodes_answered.set(answered)
+        coverage = {
+            "nodes_answered": answered,
+            "nodes_total": total,
+            "partial": 0 < answered < total,
+        }
+        m.fleet_query_coverage.set(answered / total if total else 0.0)
+        doc: dict[str, Any] = {"t0": e0, "t1": e1, "coverage": coverage}
+        if answered == 0:
+            # A scatter nobody answered is an outage signal, not an
+            # empty range.
+            doc["error"] = "no nodes answered"
+            return 503, doc, "error"
+
+        # Seed agreement: sketches only merge under one seed set; a
+        # misconfigured node's arrays would silently corrupt the fold.
+        seeds = next(
+            (r["seeds"] for r in results if r["arrays"] is not None), {}
+        )
+        parts: list[dict] = []
+        epochs: set[int] = set()
+        for r in results:
+            if r["arrays"] is None:
+                continue
+            if r["seeds"] != seeds:
+                self._count_node_error("seed_mismatch")
+                coverage["nodes_answered"] -= 1
+                coverage["partial"] = True
+                continue
+            parts.append(r["arrays"])
+            epochs.update(int(e) for e in r["epochs"])
+        if not parts:
+            doc["windows"] = 0
+            doc["empty"] = True
+            return 200, doc, "empty"
+        newest_seen = max(epochs)
+        if newest_seen > self._newest:
+            self._newest = newest_seen
+            self._edge += 1
+        doc["windows"] = len(epochs)
+        doc["epochs"] = sorted(epochs)
+
+        merged = self._fold_many(parts, seeds)
+        extras = range_extract(merged, seeds)
+        dec = range_decode(merged, seeds)
+        keys, counts = range_topk(merged, seeds, fam=fam, k=k,
+                                  est=extras.get(f"{fam}_est"))
+        doc["topk"] = {
+            "family": fam,
+            "keys": [
+                {"key": format_key(row), "count": int(c)}
+                for row, c in zip(keys, counts)
+            ],
+        }
+        doc["cardinality"] = extras.get("cardinality", 0.0)
+        doc["entropy_bits"] = extras.get("entropy_bits", {})
+        if dec is not None:
+            srcs, pkts = dec["sources"]
+            doc["decode"] = {
+                "n_keys": int(len(dec["keys"])),
+                "keys": [format_key(row) for row in dec["keys"][:k]],
+                "est": [int(x) for x in dec["est"][:k]],
+                "sources": [
+                    {"src_ip": int(s), "packets": int(p)}
+                    for s, p in zip(srcs[:k], pkts[:k])
+                ],
+            }
+        return 200, doc, "partial" if coverage["partial"] else "ok"
+
+    def _fold_many(self, parts: list[dict], seeds: dict) -> dict:
+        """Chunked semilattice reduction: fold at most FOLD_CHUNK
+        operands per call until one snapshot remains. Associativity
+        makes this exactly the flat fold while keeping every jit
+        signature small and fan-out-independent."""
+        while len(parts) > 1:
+            nxt = []
+            for i in range(0, len(parts), FOLD_CHUNK):
+                chunk = parts[i:i + FOLD_CHUNK]
+                nxt.append(
+                    chunk[0] if len(chunk) == 1
+                    else self.fold.fold(chunk, seeds)
+                )
+            parts = nxt
+        return parts[0]
+
+    def _count_node_error(self, reason: str) -> None:
+        get_metrics().fleet_query_node_errors.labels(reason=reason).inc()
+        self.node_errors[reason] = self.node_errors.get(reason, 0) + 1
+
+    # -- scatter with per-node deadline + hedged retry -----------------
+    def _ensure_pool(self) -> concurrent.futures.ThreadPoolExecutor:
+        with self._pool_lock:
+            if self._pool is None:
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=max(2, int(self.cfg.fleetquery_fanout)),
+                    thread_name_prefix="fleetquery",
+                )
+            return self._pool
+
+    def _scatter(self, e0: int, e1: int) -> list[dict]:
+        m = get_metrics()
+        deadline_s = float(self.cfg.fleetquery_node_deadline_s)
+        hedge_s = float(self.cfg.fleetquery_hedge_delay_s)
+        pool = self._ensure_pool()
+        t0 = time.monotonic()
+        first = {
+            c.name: pool.submit(c.query, e0, e1, deadline_s)
+            for c in self.clients
+        }
+        # Hedge window: after hedge_s of quiet, unfinished nodes get
+        # one duplicate request (tail latency is usually one slow
+        # replica, not a dead one).
+        concurrent.futures.wait(
+            list(first.values()), timeout=min(hedge_s, deadline_s)
+        )
+        hedged: dict[str, concurrent.futures.Future] = {}
+        for c in self.clients:
+            if not first[c.name].done():
+                hedged[c.name] = pool.submit(c.query, e0, e1, deadline_s)
+                self.hedges += 1
+                m.fleet_query_hedges.inc()
+        results: list[dict] = []
+        for c in self.clients:
+            res, reason = None, None
+            budget = deadline_s - (time.monotonic() - t0)
+            try:
+                res = first[c.name].result(timeout=max(0.0, budget))
+            except concurrent.futures.TimeoutError:
+                reason = "timeout"
+            except Exception:
+                reason = "error"
+            if res is None and c.name in hedged:
+                # The hedge launched hedge_s late; give it the same
+                # grace past the primary deadline.
+                budget = (deadline_s + hedge_s) - (time.monotonic() - t0)
+                try:
+                    res = hedged[c.name].result(timeout=max(0.0, budget))
+                    reason = None if res is not None else reason
+                except concurrent.futures.TimeoutError:
+                    reason = reason or "timeout"
+                except Exception:
+                    reason = reason or "error"
+            if res is not None:
+                results.append(res)
+            else:
+                self._count_node_error(reason or "dead")
+        return results

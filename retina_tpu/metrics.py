@@ -207,6 +207,35 @@ class Metrics:
             mn.AUTOCAPTURE_ARTIFACT_BYTES, []
         )
         self.autocapture_last_epoch = g(mn.AUTOCAPTURE_LAST_EPOCH, [])
+        # Pluggable detector bank (detect/): per-detector firing
+        # telemetry; label space is the fixed detector registry.
+        self.detector_fired = c(mn.DETECTOR_FIRED, [mn.L_DETECTOR])
+        self.detector_suppressed = c(
+            mn.DETECTOR_SUPPRESSED, [mn.L_DETECTOR, mn.L_REASON]
+        )
+        self.detector_score = g(mn.DETECTOR_SCORE, [mn.L_DETECTOR])
+        self.detector_zscore = g(mn.DETECTOR_ZSCORE, [mn.L_DETECTOR])
+        self.detector_last_epoch = g(
+            mn.DETECTOR_LAST_EPOCH, [mn.L_DETECTOR]
+        )
+        # Fleet query plane (fleetquery/): scatter-gather fan-out
+        # telemetry; buckets match timetravel_query_seconds so node
+        # and fleet p99s read off the same grid.
+        self.fleet_query_requests = c(
+            mn.FLEET_QUERY_REQUESTS, [mn.L_STATUS]
+        )
+        self.fleet_query_seconds = ex.new_histogram(
+            mn.FLEET_QUERY_SECONDS, [],
+            buckets=[1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0, 3.0],
+        )
+        self.fleet_query_nodes_answered = g(
+            mn.FLEET_QUERY_NODES_ANSWERED, []
+        )
+        self.fleet_query_node_errors = c(
+            mn.FLEET_QUERY_NODE_ERRORS, [mn.L_REASON]
+        )
+        self.fleet_query_hedges = c(mn.FLEET_QUERY_HEDGES, [])
+        self.fleet_query_coverage = g(mn.FLEET_QUERY_COVERAGE, [])
         # Endurance soak harness (soak/runner.py): phase progress +
         # sentinel verdicts, scrapeable mid-soak.
         self.soak_phases = c(mn.TPU_SOAK_PHASES, [])

@@ -86,6 +86,20 @@ class DnsPlugin(Plugin):
         with self._lock:
             return self.names.get(qname_hash, f"unknown:{qname_hash:#x}")
 
+    def qname_length_hist(self, nbins: int = 64) -> np.ndarray:
+        """(1, nbins) f32 histogram of resolved qname string lengths —
+        the high-fidelity ``extras["qname_hist"]`` feed for the
+        dnstunnel detector: pcap-decoded records only carry a req/resp
+        marker in the F.DNS low byte, but this table has the real
+        names the wire carried."""
+        hist = np.zeros((1, nbins), np.float32)
+        with self._lock:
+            lens = [len(v) for v in self.names.values()]
+        if lens:
+            ln = np.clip(np.asarray(lens, np.int64), 0, nbins - 1)
+            hist[0] = np.bincount(ln, minlength=nbins).astype(np.float32)
+        return hist
+
     def start(self, stop: threading.Event) -> None:
         stop.wait()  # passive: work happens in observe_records/pubsub
 

@@ -14,6 +14,6 @@ invertible decode → targeted capture of only the attributed keys.
 """
 
 from retina_tpu.timetravel.fold import RangeFold
-from retina_tpu.timetravel.ring import SnapshotRing
+from retina_tpu.timetravel.ring import RingProtocol, SnapshotRing
 
-__all__ = ["RangeFold", "SnapshotRing"]
+__all__ = ["RangeFold", "RingProtocol", "SnapshotRing"]

@@ -25,13 +25,40 @@ from __future__ import annotations
 import collections
 import queue as queue_mod
 import threading
-from typing import Any
+from typing import Any, Protocol, runtime_checkable
 
 import numpy as np
 
 from retina_tpu.log import logger, rate_limited
 from retina_tpu.metrics import get_metrics
 from retina_tpu.utils.device_proxy import fetch_on_device
+
+
+@runtime_checkable
+class RingProtocol(Protocol):
+    """The read surface every snapshot-history provider exposes.
+
+    Both the engine's per-window ring and the fleet aggregator's
+    merged-epoch ring (``FleetAggregator.epoch_ring``) satisfy this, so
+    the node query tier (timetravel/query.py) and the fleet query plane
+    (fleetquery/service.py) fold over either interchangeably. Slots are
+    ``(epoch, arrays, window_s, seeds)`` tuples in the fleet array
+    catalog.
+    """
+
+    name: str
+    appended: int
+
+    def select(
+        self, e0: int, e1: int
+    ) -> list[tuple[int, dict[str, np.ndarray], float, dict[str, int]]]:
+        ...
+
+    def span(self) -> tuple[int, int]:
+        ...
+
+    def stats(self) -> dict:
+        ...
 
 
 class SnapshotRing:

@@ -222,6 +222,36 @@ AUTOCAPTURE_KEYS = AUTOCAPTURE_PREFIX + "attributed_keys"
 AUTOCAPTURE_ARTIFACT_BYTES = AUTOCAPTURE_PREFIX + "artifact_bytes"
 AUTOCAPTURE_LAST_EPOCH = AUTOCAPTURE_PREFIX + "last_epoch"
 
+# Pluggable detector bank (retina_tpu/detect/): fired counts accepted
+# firings per detector (the ones handed to the capture sink);
+# suppressed counts firings absorbed by reason (cooldown/warmup/
+# disabled — fixed set); score is the last raw detector statistic
+# (ports-per-source estimate, qname-length entropy bits, SYN:ACK
+# ratio), zscore the EWMA z it was judged by; last_epoch is the last
+# window-epoch each detector fired on.
+DETECTOR_PREFIX = PREFIX + "tpu_detector_"
+DETECTOR_FIRED = DETECTOR_PREFIX + "fired_counter"
+DETECTOR_SUPPRESSED = DETECTOR_PREFIX + "suppressed_counter"
+DETECTOR_SCORE = DETECTOR_PREFIX + "score"
+DETECTOR_ZSCORE = DETECTOR_PREFIX + "zscore"
+DETECTOR_LAST_EPOCH = DETECTOR_PREFIX + "last_epoch"
+
+# Fleet query plane (retina_tpu/fleetquery/): requests counts
+# /fleet/query requests by terminal status (ok/partial/stale/busy/
+# empty/bad_request/error — fixed set), seconds is the handler latency
+# histogram the fleet p99 bound is read from; nodes_answered is the
+# per-gather answered-node count and coverage_ratio the matching
+# answered/total fraction (1.0 = full coverage); node_errors counts
+# per-node scatter failures by reason (timeout/dead/seed_mismatch —
+# fixed set); hedges counts hedged second attempts issued.
+FLEET_QUERY_PREFIX = PREFIX + "fleet_query_"
+FLEET_QUERY_REQUESTS = FLEET_QUERY_PREFIX + "requests_counter"
+FLEET_QUERY_SECONDS = FLEET_QUERY_PREFIX + "seconds"
+FLEET_QUERY_NODES_ANSWERED = FLEET_QUERY_PREFIX + "nodes_answered"
+FLEET_QUERY_NODE_ERRORS = FLEET_QUERY_PREFIX + "node_errors_counter"
+FLEET_QUERY_HEDGES = FLEET_QUERY_PREFIX + "hedges_counter"
+FLEET_QUERY_COVERAGE = FLEET_QUERY_PREFIX + "coverage_ratio"
+
 # Endurance soak harness (retina_tpu/soak/): phase progress and
 # sentinel verdicts for a live `bench.py --soak` run, scrapeable
 # mid-soak so an operator (or the alert rules) can watch a multi-hour
@@ -314,3 +344,4 @@ L_SERVICE = "service"
 L_RING = "ring"
 L_STATUS = "status"
 L_SENTINEL = "sentinel"
+L_DETECTOR = "detector"

@@ -1,0 +1,172 @@
+#!/usr/bin/env python3
+"""Fixture generator: two more REAL loopback captures for the
+detector/replay arc (see README.md provenance table).
+
+- ``loopback_dns_real.pcap``: genuine DNS queries/responses over
+  UDP:53 on ``lo`` — a tiny UDP responder bound to 127.0.0.1:53
+  answers standard-format queries sent through the real Linux stack,
+  so every Ethernet/IPv4/UDP header byte is kernel-built and the
+  payloads are well-formed DNS messages (built with struct here, in a
+  standalone tool — NOT by the repo's encoders under test).
+- ``loopback_mixed_real.pcap``: a benign service mix — short TCP
+  connections and UDP datagrams across a handful of service-style
+  ports — the realistic-negative feed for the detector bank.
+
+Run as root on any Linux host:  python capture_detector_flows.py
+"""
+import socket
+import struct
+import threading
+import time
+
+DNS_OUT = "loopback_dns_real.pcap"
+MIX_OUT = "loopback_mixed_real.pcap"
+DNS_PORT = 53
+MIX_TCP_PORTS = (41080, 41443, 41432)
+MIX_UDP_PORT = 41514
+
+QNAMES = [
+    "svc-a.cluster.local", "svc-b.cluster.local",
+    "db.internal.example", "cache.internal.example",
+    "api.prod.example.com", "web.prod.example.com",
+]
+
+
+def dns_query(qname: str, qid: int) -> bytes:
+    q = b"".join(
+        bytes([len(l)]) + l.encode() for l in qname.split(".")
+    ) + b"\x00"
+    return struct.pack(">HHHHHH", qid, 0x0100, 1, 0, 0, 0) + q + struct.pack(">HH", 1, 1)
+
+
+def open_capture() -> socket.socket:
+    cap = socket.socket(
+        socket.AF_PACKET, socket.SOCK_RAW, socket.htons(0x0003)
+    )
+    cap.bind(("lo", 0))
+    cap.settimeout(0.2)
+    return cap
+
+
+def drain(cap: socket.socket, keep, budget_s: float = 1.0) -> list[bytes]:
+    frames = []
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        try:
+            fr = cap.recv(65535)
+        except socket.timeout:
+            break
+        if keep(fr):
+            frames.append(fr)
+    return frames
+
+
+def port_filter(ports: set[int]):
+    def keep(fr: bytes) -> bool:
+        if len(fr) < 38 or fr[12:14] != b"\x08\x00":
+            return False
+        ihl = (fr[14] & 0x0F) * 4
+        proto = fr[14 + 9]
+        if proto not in (6, 17):
+            return False
+        sport, dport = struct.unpack_from(">HH", fr, 14 + ihl)
+        return {sport, dport} & ports != set()
+    return keep
+
+
+def write_pcap(path: str, frames: list[bytes]) -> None:
+    with open(path, "wb") as f:
+        f.write(struct.pack(
+            "<IHHiIII", 0xA1B23C4D, 2, 4, 0, 0, 65535, 1
+        ))
+        ts = 1_700_000_000_000_000_000
+        for fr in frames:
+            f.write(struct.pack(
+                "<IIII", ts // 10**9, ts % 10**9, len(fr), len(fr)
+            ))
+            f.write(fr)
+            ts += 1000
+
+
+def capture_dns() -> None:
+    cap = open_capture()
+    srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv.bind(("127.0.0.1", DNS_PORT))
+    srv.settimeout(1.0)
+
+    def responder() -> None:
+        for _ in QNAMES:
+            try:
+                data, addr = srv.recvfrom(512)
+            except socket.timeout:
+                return
+            # NOERROR response echoing the question, one dummy A RR.
+            resp = (
+                data[:2] + struct.pack(">HHHHH", 0x8180, 1, 1, 0, 0)
+                + data[12:]
+                + b"\xc0\x0c" + struct.pack(">HHIH", 1, 1, 60, 4)
+                + socket.inet_aton("127.0.0.1")
+            )
+            srv.sendto(resp, addr)
+
+    t = threading.Thread(target=responder, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    for i, name in enumerate(QNAMES):
+        tx.sendto(dns_query(name, 0x4000 + i), ("127.0.0.1", DNS_PORT))
+        time.sleep(0.02)
+    t.join(timeout=2.0)
+    frames = drain(cap, port_filter({DNS_PORT}))
+    cap.close()
+    srv.close()
+    tx.close()
+    write_pcap(DNS_OUT, frames)
+    print(f"wrote {len(frames)} kernel-built DNS frames to {DNS_OUT}")
+
+
+def capture_mix() -> None:
+    cap = open_capture()
+    servers = []
+    for port in MIX_TCP_PORTS:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("127.0.0.1", port))
+        s.listen(2)
+        servers.append(s)
+        threading.Thread(
+            target=lambda srv=s: [
+                srv.accept()[0].recv(128) for _ in range(2)
+            ],
+            daemon=True,
+        ).start()
+    usrv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    usrv.bind(("127.0.0.1", MIX_UDP_PORT))
+
+    time.sleep(0.1)
+    for port in MIX_TCP_PORTS:
+        for i in range(2):
+            c = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            c.connect(("127.0.0.1", port))
+            c.send(b"retina-mix-fixture-%d-%d" % (port, i))
+            c.close()
+            time.sleep(0.01)
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    for i in range(4):
+        tx.sendto(b"retina-mix-udp-%d" % i, ("127.0.0.1", MIX_UDP_PORT))
+        time.sleep(0.01)
+    time.sleep(0.2)
+    frames = drain(
+        cap, port_filter(set(MIX_TCP_PORTS) | {MIX_UDP_PORT})
+    )
+    cap.close()
+    usrv.close()
+    tx.close()
+    for s in servers:
+        s.close()
+    write_pcap(MIX_OUT, frames)
+    print(f"wrote {len(frames)} kernel-built mixed frames to {MIX_OUT}")
+
+
+if __name__ == "__main__":
+    capture_dns()
+    capture_mix()

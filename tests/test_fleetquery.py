@@ -1,0 +1,373 @@
+"""Fleet query plane: federation semantics + the bounded-latency
+contract (fleetquery/service.py).
+
+Federation correctness rides on the RFLT semilattice: a scatter over N
+nodes merged with the chunked ``_fold_many`` must equal ONE flat fold
+over the same node snapshots (associativity), and a node-local span
+fold shipped as one snapshot must compose with the cluster merge
+(test_timetravel.py proves the slot-level algebra; here we pin the
+two-level split the fleet plane adds).
+
+The latency contract is PR 10's node-tier contract verbatim: handler
+threads never queue behind a scatter or a fold — single-flight +
+TTL/immutable cache + serve-stale — plus the fleet-only clauses:
+per-node deadline with hedged retry, partial answers annotated with
+``coverage``, seed-mismatch quarantine, and SHEDDING never starting a
+fleet fan-out. The 64-node storm numbers live in the dryrun
+(``bench.py --fleetquery-dryrun``); these tests pin each clause
+deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from retina_tpu.config import Config
+from retina_tpu.fleet.dryrun import (
+    INV_SEEDS, _invertible_arrays, _sketch_arrays,
+)
+from retina_tpu.fleetquery.service import (
+    FleetQueryService, LocalNodeClient,
+)
+from retina_tpu.runtime.overload import NOMINAL, SHEDDING
+from retina_tpu.timetravel.fold import (
+    RangeFold, range_extract, range_topk,
+)
+from retina_tpu.timetravel.ring import SnapshotRing
+
+FOLD = RangeFold()  # shared: one jit cache across the module
+E0 = 100  # first ring epoch
+
+
+class _Ov:
+    state = NOMINAL
+
+
+def _slot(rng, n_keys: int = 32, heavy=None):
+    keys = rng.integers(0, 2**32, size=(n_keys, 4), dtype=np.uint32)
+    w = rng.integers(1, 20, n_keys).astype(np.int64)
+    if heavy is not None:
+        keys = np.concatenate([keys, heavy.astype(np.uint32)])
+        w = np.concatenate([w, np.full(len(heavy), 5000, np.int64)])
+    arrays = _sketch_arrays(keys, w.astype(np.float64))
+    arrays.update(_invertible_arrays(keys, w, np.zeros(len(w), bool)))
+    return arrays
+
+
+def _cfg(**kw):
+    kw.setdefault("fleetquery_enabled", True)
+    kw.setdefault("fleetquery_node_deadline_s", 5.0)
+    kw.setdefault("fleetquery_hedge_delay_s", 1.0)
+    kw.setdefault("fleetquery_fanout", 4)
+    kw.setdefault("fleetquery_cache_ttl_s", 60.0)
+    return Config(**kw)
+
+
+def _fleet(n_nodes=3, n_windows=4, latencies=None, seed=11, **cfg_kw):
+    """A fleet of in-process nodes, every node holding the SAME window
+    slots (so the merged answer has a closed-form reference)."""
+    cfg = _cfg(**cfg_kw)
+    ov = _Ov()
+    svc = FleetQueryService(cfg, overload=ov, fold=FOLD)
+    rng = np.random.default_rng(seed)
+    slots = [_slot(rng) for _ in range(n_windows)]
+    for i in range(n_nodes):
+        ring = SnapshotRing(16, name=f"n{i}")
+        for e, arr in enumerate(slots):
+            ring.append_host(E0 + e, arr, 1.0, INV_SEEDS)
+        lat = latencies[i] if latencies else 0.0
+        svc.add_client(LocalNodeClient(f"n{i}", ring, FOLD,
+                                       latency_s=lat))
+    return svc, ov, slots
+
+
+def _handle(svc, q):
+    code, body, ctype = svc.handle(q)
+    assert ctype == "application/json"
+    return code, json.loads(body)
+
+
+# -- federation semantics ----------------------------------------------
+
+def test_scatter_merge_equals_flat_fold():
+    """3 identical nodes over 4 windows: the federated answer equals
+    fold([node_span] * 3) computed by hand — the two-level split
+    (node span fold, then cluster chunk fold) is exact."""
+    svc, _, slots = _fleet()
+    code, doc = _handle(svc, {"t0": [str(E0)], "t1": [str(E0 + 4)]})
+    assert code == 200
+    assert doc["windows"] == 4
+    assert doc["epochs"] == [E0, E0 + 1, E0 + 2, E0 + 3]
+    assert doc["coverage"] == {"nodes_answered": 3, "nodes_total": 3,
+                               "partial": False}
+
+    span = FOLD.fold(slots, INV_SEEDS)
+    merged = FOLD.fold([span] * 3, INV_SEEDS)
+    ex = range_extract(merged, INV_SEEDS)
+    k = int(svc.cfg.fleetquery_topk)
+    keys, counts = range_topk(merged, INV_SEEDS, fam="flow", k=k,
+                              est=ex.get("flow_est"))
+    assert doc["cardinality"] == pytest.approx(ex["cardinality"])
+    assert [e["count"] for e in doc["topk"]["keys"]] == \
+        [int(c) for c in counts]
+
+
+def test_fold_many_chunking_matches_flat(monkeypatch):
+    """_fold_many with a tiny chunk size reduces 5 snapshots to the
+    same arrays as one flat fold (associativity, the property that
+    makes chunking a latency knob instead of a semantics change)."""
+    import retina_tpu.fleetquery.service as fqs
+
+    monkeypatch.setattr(fqs, "FOLD_CHUNK", 2)
+    rng = np.random.default_rng(23)
+    parts = [_slot(rng) for _ in range(5)]
+    svc = FleetQueryService(_cfg(), fold=FOLD)
+    chunked = svc._fold_many([dict(p) for p in parts], INV_SEEDS)
+    flat = FOLD.fold(parts, INV_SEEDS)
+    for name in ("flow_cms", "entropy", "hll_flows", "totals",
+                 "inv_flow_planes", "inv_flow_weights"):
+        np.testing.assert_array_equal(chunked[name], flat[name],
+                                      err_msg=name)
+
+
+def test_dead_node_partial_coverage():
+    svc, _, _ = _fleet()
+    svc.clients[1].dead = True
+    code, doc = _handle(svc, {"t0": [str(E0)], "t1": [str(E0 + 4)]})
+    assert code == 200
+    assert doc["coverage"] == {"nodes_answered": 2, "nodes_total": 3,
+                               "partial": True}
+    assert doc["windows"] == 4  # surviving nodes still cover the span
+    assert svc.node_errors.get("dead", 0) >= 1
+
+
+def test_all_nodes_dead_is_outage_not_empty():
+    svc, _, _ = _fleet()
+    for c in svc.clients:
+        c.dead = True
+    code, doc = _handle(svc, {"t0": [str(E0)], "t1": [str(E0 + 4)]})
+    assert code == 503
+    assert doc["error"] == "no nodes answered"
+    assert doc["coverage"]["nodes_answered"] == 0
+
+
+def test_seed_mismatch_node_is_quarantined():
+    """A node whose ring carries different sketch seeds must be
+    dropped from the merge (its arrays would silently corrupt the
+    fold), counted, and reflected in coverage."""
+    svc, _, slots = _fleet()
+    bad = SnapshotRing(16, name="bad-seeds")
+    for e, arr in enumerate(slots):
+        bad.append_host(E0 + e, arr, 1.0,
+                        dict(INV_SEEDS, flow=999))
+    svc.clients[1].ring = bad
+    code, doc = _handle(svc, {"t0": [str(E0)], "t1": [str(E0 + 4)]})
+    assert code == 200
+    assert doc["coverage"] == {"nodes_answered": 2, "nodes_total": 3,
+                               "partial": True}
+    assert svc.node_errors.get("seed_mismatch", 0) >= 1
+
+
+def test_empty_range_answers_empty_not_error():
+    svc, _, _ = _fleet()
+    code, doc = _handle(svc, {"t0": [str(E0 + 50)],
+                              "t1": [str(E0 + 60)]})
+    assert code == 200
+    assert doc["empty"] and doc["windows"] == 0
+    assert doc["coverage"]["nodes_answered"] == 3
+
+
+# -- bounded-latency contract ------------------------------------------
+
+def _establish_span(svc):
+    """One full-range scatter: teaches the service the fleet's newest
+    epoch (before that, EVERY range keys on the live edge — the
+    service cannot know a range is immutable until it has seen the
+    span once)."""
+    assert _handle(svc, {"t0": [str(E0)], "t1": [str(E0 + 4)]})[0] == 200
+
+
+def test_immutable_range_serves_from_cache():
+    svc, _, _ = _fleet()
+    _establish_span(svc)
+    # [E0, E0+3) ends strictly before the newest known epoch:
+    # immutable, stable cache key.
+    q = {"t0": [str(E0)], "t1": [str(E0 + 3)]}
+    assert _handle(svc, q)[0] == 200
+    calls = [c.calls for c in svc.clients]
+    # Repeat inside TTL: a cache hit, no node sees a second request.
+    code, doc = _handle(svc, q)
+    assert code == 200 and "stale" not in doc
+    assert [c.calls for c in svc.clients] == calls
+
+
+def test_ttl_expiry_rescatters():
+    svc, _, _ = _fleet(fleetquery_cache_ttl_s=0.05)
+    import time
+
+    q = {"t0": [str(E0)], "t1": [str(E0 + 4)]}
+    _handle(svc, q)
+    calls = [c.calls for c in svc.clients]
+    time.sleep(0.1)
+    assert _handle(svc, q)[0] == 200
+    assert all(c.calls > before
+               for c, before in zip(svc.clients, calls))
+
+
+def test_live_edge_invalidation_on_note_append():
+    """Ranges past the newest known epoch key on the edge token: a
+    repeat is cached until note_append signals new fleet epochs, then
+    the same range re-scatters and picks up the new window."""
+    svc, _, slots = _fleet()
+    _establish_span(svc)
+    q = {"t0": [str(E0)], "t1": [str(E0 + 5)]}  # e1 beyond newest
+    code, doc = _handle(svc, q)
+    assert code == 200 and doc["windows"] == 4
+    calls = [c.calls for c in svc.clients]
+    assert _handle(svc, q)[1]["windows"] == 4  # cached
+    assert [c.calls for c in svc.clients] == calls
+
+    rng = np.random.default_rng(99)
+    for c in svc.clients:
+        c.ring.append_host(E0 + 4, _slot(rng), 1.0, INV_SEEDS)
+    svc.note_append()
+    code, doc = _handle(svc, q)
+    assert code == 200 and doc["windows"] == 5
+    assert all(c.calls > before
+               for c, before in zip(svc.clients, calls))
+
+
+def test_busy_single_flight_and_serve_stale():
+    """A handler thread that cannot take the flight lock NEVER waits:
+    uncached -> immediate 503 busy; cached-but-stale -> the stale doc,
+    marked."""
+    svc, _, _ = _fleet(fleetquery_cache_ttl_s=0.01)
+    import time
+
+    q = {"t0": [str(E0)], "t1": [str(E0 + 3)]}
+    assert svc._flight.acquire(blocking=False)
+    try:
+        code, doc = _handle(svc, q)
+        assert code == 503 and doc["error"] == "busy" and doc["retry"]
+    finally:
+        svc._flight.release()
+
+    _establish_span(svc)
+    _handle(svc, q)  # prime the cache (immutable key)
+    time.sleep(0.05)  # let it go stale
+    assert svc._flight.acquire(blocking=False)
+    try:
+        calls = [c.calls for c in svc.clients]
+        code, doc = _handle(svc, q)
+        assert code == 200 and doc["stale"] is True
+        assert [c.calls for c in svc.clients] == calls
+    finally:
+        svc._flight.release()
+
+
+def test_shedding_never_scatters():
+    """Under SHEDDING a fleet fan-out is exactly the load this node
+    must not add: cached docs serve (TTL ignored, stale-marked),
+    everything else is busy — and no node sees a single request."""
+    svc, ov, _ = _fleet(fleetquery_cache_ttl_s=0.01)
+    import time
+
+    _establish_span(svc)
+    q = {"t0": [str(E0)], "t1": [str(E0 + 3)]}
+    _handle(svc, q)  # prime while NOMINAL (immutable key)
+    time.sleep(0.05)  # past TTL
+    ov.state = SHEDDING
+    calls = [c.calls for c in svc.clients]
+
+    code, doc = _handle(svc, q)
+    assert code == 200 and doc["stale"] is True
+    code, doc = _handle(svc, {"t0": [str(E0 + 1)], "t1": [str(E0 + 3)]})
+    assert code == 503 and doc["error"] == "busy"
+    assert [c.calls for c in svc.clients] == calls  # zero fan-out
+
+
+def test_hedged_retry_fires_for_slow_node():
+    """A node slower than the hedge delay gets exactly one duplicate
+    request; the answer still arrives complete within the deadline."""
+    svc, _, _ = _fleet(latencies=[0.0, 0.3, 0.0],
+                       fleetquery_hedge_delay_s=0.05)
+    code, doc = _handle(svc, {"t0": [str(E0)], "t1": [str(E0 + 4)]})
+    assert code == 200
+    assert doc["coverage"]["partial"] is False
+    assert svc.hedges == 1
+    assert svc.clients[1].calls == 2  # primary + hedge
+    assert not svc.node_errors
+
+
+# -- aggregator-resident ring mode -------------------------------------
+
+def test_ring_mode_folds_merged_epochs():
+    """No scatter tier: the service folds the aggregator's merged
+    epoch ring directly, coverage is the single merged source, and
+    ``last=N`` addresses the ring span."""
+    svc = FleetQueryService(_cfg(), overload=_Ov(), fold=FOLD)
+    ring = SnapshotRing(8, name="fleet-epochs")
+    rng = np.random.default_rng(31)
+    for e in range(3):
+        ring.append_host(200 + e, _slot(rng), 1.0, INV_SEEDS)
+    svc.add_ring(ring)
+
+    code, doc = _handle(svc, {"last": ["2"]})
+    assert code == 200
+    assert doc["epochs"] == [201, 202]
+    assert doc["coverage"] == {"nodes_answered": 1, "nodes_total": 1,
+                               "partial": False}
+    assert doc["topk"]["keys"]
+
+    empty = FleetQueryService(_cfg(), fold=FOLD)
+    empty.add_ring(SnapshotRing(4, name="fleet-epochs"))
+    code, doc = _handle(empty, {"last": ["1"]})
+    assert code == 400  # span unknown yet
+
+
+# -- request validation ------------------------------------------------
+
+def test_bad_requests():
+    svc, _, _ = _fleet()
+    assert _handle(svc, {})[0] == 400
+    assert _handle(svc, {"t0": ["5"], "t1": ["5"]})[0] == 400
+    assert _handle(svc, {"t0": ["x"], "t1": ["9"]})[0] == 400
+    # last=N before any scatter established the fleet span.
+    assert _handle(svc, {"last": ["2"]})[0] == 400
+    # ...and after one query the span is known.
+    assert _handle(svc, {"t0": [str(E0)], "t1": [str(E0 + 4)]})[0] == 200
+    assert _handle(svc, {"last": ["2"]})[0] == 200
+
+    bare = FleetQueryService(_cfg(), fold=FOLD)
+    assert _handle(bare, {"last": ["1"]})[0] == 404  # no sources
+
+
+# -- node client -------------------------------------------------------
+
+def test_local_node_client_span_cache_and_kill_switch():
+    rng = np.random.default_rng(41)
+    ring = SnapshotRing(8, name="n0")
+    slots = [_slot(rng) for _ in range(3)]
+    for e, arr in enumerate(slots):
+        ring.append_host(E0 + e, arr, 1.0, INV_SEEDS)
+    c = LocalNodeClient("n0", ring, FOLD)
+
+    one = c.query(E0, E0 + 1, 5.0)
+    assert one["epochs"] == [E0] and one["window_s"] == 1.0
+    # Single-slot spans ship the slot arrays unfolded.
+    assert one["arrays"] is slots[0]
+
+    r1 = c.query(E0, E0 + 3, 5.0)
+    r2 = c.query(E0, E0 + 3, 5.0)
+    assert c.calls == 3
+    assert r1["arrays"] is r2["arrays"]  # per-generation span cache
+    # A ring append changes the generation: same span, fresh fold.
+    ring.append_host(E0 + 3, _slot(rng), 1.0, INV_SEEDS)
+    r3 = c.query(E0, E0 + 3, 5.0)
+    assert r3["epochs"] == [E0, E0 + 1, E0 + 2]
+
+    c.dead = True
+    assert c.query(E0, E0 + 3, 5.0) is None

@@ -783,6 +783,26 @@ def entry_audits() -> list[EntryAudit]:
     ex_low = _extract_program(ex_names, ex_shapes, seeds).lower(sub)
     audits.append(_audit("timetravel.range_extract", ex_low, 1))
 
+    # -- detector scoring programs ------------------------------------
+    # Tiny host-built feature inputs (detect/features.py); no donation:
+    # the arrays are window accumulators the host reuses.
+    from retina_tpu.detect.programs import (
+        dnstunnel_program, portscan_program, synflood_program,
+    )
+
+    ps_keys = jnp.zeros((16, 4), jnp.uint32)
+    ps_w = jnp.zeros((16,), jnp.float32)
+    ps_low = portscan_program(16, 8, 4, 0x5CA7).lower(ps_keys, ps_w)
+    audits.append(_audit("detect.portscan", ps_low, 2))
+
+    dt_low = dnstunnel_program(64, 0xD25).lower(
+        jnp.zeros((1, 64), jnp.float32)
+    )
+    audits.append(_audit("detect.dnstunnel", dt_low, 1))
+
+    sf_low = synflood_program().lower(jnp.zeros((9,), jnp.float32))
+    audits.append(_audit("detect.synflood", sf_low, 1))
+
     return audits
 
 
@@ -929,6 +949,9 @@ RECIPE_COVERAGE = {
     "timetravel.range_fold": "merge+audit",
     "timetravel.range_decode": "audit",
     "timetravel.range_extract": "audit",
+    "detect.portscan": "audit",
+    "detect.dnstunnel": "audit",
+    "detect.synflood": "audit",
 }
 
 

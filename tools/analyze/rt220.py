@@ -197,7 +197,9 @@ def check_program(ctxs: list[FileCtx], rep: Reporter, root: Path) -> None:
                        "networkobservability_fleet",
                        "networkobservability_tpu_timetravel",
                        "networkobservability_tpu_autocapture",
-                       "networkobservability_tpu_soak"):
+                       "networkobservability_tpu_soak",
+                       "networkobservability_tpu_detector",
+                       "networkobservability_fleet_query"):
                 continue  # prose mention of a family prefix
             if tok not in doc_ok:
                 rep.add(doc_ctx, i, "RT223",
