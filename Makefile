@@ -3,7 +3,7 @@
 
 .PHONY: test native bench bench-smoke soak soak-smoke distributed \
 	chaos lint analyze-device query-dryrun fleetquery-dryrun \
-	trace-dryrun clean
+	trace-dryrun churn-smoke clean
 
 native:
 	$(MAKE) -C retina_tpu/native
@@ -29,6 +29,14 @@ query-dryrun: native
 # is `python bench.py --fleetquery-dryrun` on hardware.
 fleetquery-dryrun: native
 	python bench.py --fleetquery-dryrun --smoke
+
+# Multi-process fleet churn, CI-sized: 12 real node-agent processes,
+# 3 zone relays re-shipping to a root aggregator, rolling restart +
+# both asymmetric partitions + a live seed rotation, scored against
+# exact ground truth. The 64-process acceptance run is
+# `python bench.py --churn-dryrun`. See docs/operations.md §10.
+churn-smoke: native
+	python bench.py --churn-dryrun --smoke
 
 # Flight-recorder acceptance: the <3% overhead guard, the debug
 # endpoints, and the fleet dryrun's cross-process span-lineage check

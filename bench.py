@@ -1077,6 +1077,19 @@ def main() -> None:
                          "artifact is produced — while concurrent "
                          "scrapes (half under forced SHEDDING) hammer "
                          "the query API")
+    ap.add_argument("--churn-dryrun", action="store_true",
+                    help="multi-process churn dryrun: >=64 real node-"
+                         "agent child processes ship RFLT frames over "
+                         "real gRPC relays into a two-level zone->root "
+                         "rollup, through rolling restarts, asymmetric "
+                         "partitions, and a live seed rotation (with "
+                         "--smoke: 12 processes, 3 zones)")
+    ap.add_argument("--churn-nodes", type=int, default=None,
+                    help="child process count for --churn-dryrun "
+                         "(default 64, or 12 with --smoke)")
+    ap.add_argument("--churn-zones", type=int, default=None,
+                    help="zone relay count for --churn-dryrun "
+                         "(default 4, or 3 with --smoke)")
     ap.add_argument("--fleetquery-dryrun", action="store_true",
                     help="fleet query plane + detector diversity "
                          "dryrun: a 1,000-query storm over 64 simulated "
@@ -1111,6 +1124,49 @@ def main() -> None:
                 bad = [k for k, v in res["sentinels"].items()
                        if not v["ok"]]
                 out["error"] = f"soak sentinels failed: {bad}"
+        elif args.churn_dryrun:
+            from retina_tpu.fleet.churn import run_churn_dryrun
+
+            # The window interval must leave every child enough CPU to
+            # build its sketch pass each epoch (~50ms/child measured on
+            # one core) — on a big host the full run holds the 1.0s
+            # headline cadence, on a starved CI box it stretches so the
+            # fleet stays epoch-aligned instead of collapsing into a
+            # merge backlog that drains after the scored window.
+            churn_nodes = args.churn_nodes or (12 if args.smoke else 64)
+            churn_interval = (0.6 if args.smoke else max(
+                1.0, 0.08 * churn_nodes / (os.cpu_count() or 1)
+            ))
+            res = run_churn_dryrun(
+                nodes=churn_nodes,
+                zones=args.churn_zones or (3 if args.smoke else 4),
+                interval_s=churn_interval,
+                log=log,
+            )
+            out = {
+                # Acceptance: root-tier recall >= 0.95 through 10%
+                # rolling churn + partitions + a live seed rotation,
+                # with spooled frames replayed (no silent loss), every
+                # node re-admitted post-rotation, and three-tier trace
+                # lineage intact.
+                "metric": "churn_root_recall",
+                "value": res["recall_min"],
+                "unit": "recall",
+                "vs_baseline": round(res["recall_min"] / 0.95, 4),
+                "extra": res,
+            }
+            if not res["ok"]:
+                gates = {
+                    "recall": res["recall_min"] >= 0.95,
+                    "replay": (res["child_spool_replayed"] > 0
+                               and res["reship_spool_replayed"] > 0),
+                    "no_silent_loss": res["no_silent_frame_loss"],
+                    "rotation": res["rotation_readmitted_all"],
+                    "lineage": res["trace_lineage_ok"],
+                    "epochs": res["epochs_scored"] >= 8,
+                }
+                bad = [g for g, okg in gates.items() if not okg]
+                out["error"] = f"churn dryrun acceptance failed: {bad}"
         elif args.fleetquery_dryrun:
             from retina_tpu.fleetquery.dryrun import run_fleetquery_dryrun
 

@@ -340,6 +340,33 @@ class Config:
     fleet_ship_queue: int = 4
     # Under SHEDDING and above, ship only 1 window in this many.
     fleet_shed_ship_every: int = 4
+    # Node-side seed generation stamped on shipped frames; bump it when
+    # rotating sketch seeds so the aggregator and fleet query plane
+    # re-admit the node under the new generation instead of
+    # quarantining it forever (fleet/codec.py "sgen" header field).
+    fleet_seed_generation: int = 0
+    # Send-failure spool: frames held in memory while the relay is
+    # unreachable, replayed oldest-first on heal; the oldest frame is
+    # evicted (and counted) when full. 0 disables spooling and restores
+    # drop-on-error (still counted, never silent).
+    fleet_ship_spool: int = 64
+    # Jittered exponential backoff between send retries while the ship
+    # circuit is open: delay is uniform in [base/2, min(max, base*2^n)].
+    fleet_ship_backoff_base_s: float = 0.05
+    fleet_ship_backoff_max_s: float = 2.0
+    # Two-level rollup: re-ship each merged epoch as a valid RFLT
+    # snapshot to a parent aggregator's relay at this address (the
+    # zone -> root hop). "" disables — this aggregator is the root.
+    fleet_reship_addr: str = ""
+    # Defer quorum-closed epoch merges to the aggregator's poll thread
+    # instead of running them inline on the ingest (gRPC handler)
+    # thread. Keeps ingest latency flat through merge jit compiles —
+    # otherwise the quorum-completing node's ship RPC pays the whole
+    # merge and can blow its deadline, pushing that node into
+    # spool/backoff every epoch. Off by default: inline merges publish
+    # the rollup before ingest returns, which synchronous callers
+    # (tests, co-located daemons) rely on.
+    fleet_merge_async: bool = False
     fleet_topk_k: int = 32  # cluster-wide heavy-hitter series cap
     fleet_service_top: int = 16  # per-service cardinality series cap
     # Per-tenant exported-series cap (the label-space guardrail).
@@ -541,11 +568,23 @@ class Config:
                 raise ValueError(
                     f"{f} must be >= 1, got {getattr(self, f)}"
                 )
-        for f in ("fleet_expected_nodes", "fleet_max_tenants"):
+        for f in ("fleet_expected_nodes", "fleet_max_tenants",
+                  "fleet_seed_generation", "fleet_ship_spool"):
             if getattr(self, f) < 0:
                 raise ValueError(
                     f"{f} must be >= 0, got {getattr(self, f)}"
                 )
+        if self.fleet_ship_backoff_base_s <= 0:
+            raise ValueError(
+                f"fleet_ship_backoff_base_s must be > 0, "
+                f"got {self.fleet_ship_backoff_base_s}"
+            )
+        if self.fleet_ship_backoff_max_s < self.fleet_ship_backoff_base_s:
+            raise ValueError(
+                "fleet_ship_backoff_max_s must be >= "
+                f"fleet_ship_backoff_base_s, got "
+                f"{self.fleet_ship_backoff_max_s}"
+            )
         # Single source of truth for legal preset names: the PRESETS
         # table in events/synthetic.py (a name added there is legal
         # here automatically — no hand-maintained copy to drift, the

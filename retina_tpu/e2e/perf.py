@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import socket
 import subprocess
 import sys
 import threading
@@ -193,6 +194,25 @@ def default_agent_factory(cfg_overrides: dict | None = None):
     else:
         stop.set()
         raise RuntimeError("agent did not come up for perf harness")
+
+    # engine.started does NOT mean the AF_PACKET socket is attached and
+    # decoding — on a loaded box the observer thread trails the engine
+    # by seconds, and a measured blast that starts before attach
+    # records zero observed events. Gate on the first DECODED packet of
+    # a priming blast (deadline poll, no fixed sleep); if the deadline
+    # passes the caller's own assertions report the failure with the
+    # real counter, which is strictly better signal than racing.
+    ev_base = int(d.cm.engine._events_in)
+    prime = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        prime_deadline = time.monotonic() + 60
+        while (int(d.cm.engine._events_in) <= ev_base
+               and time.monotonic() < prime_deadline):
+            for _ in range(50):
+                prime.sendto(b"p" * 64, ("127.0.0.1", 9))
+            time.sleep(0.1)
+    finally:
+        prime.close()
 
     def events() -> int:
         return d.cm.engine._events_in

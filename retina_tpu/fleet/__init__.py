@@ -13,4 +13,21 @@ from retina_tpu.fleet.codec import (  # noqa: F401
     decode_snapshot, encode_snapshot,
 )
 from retina_tpu.fleet.shipper import SnapshotShipper  # noqa: F401
-from retina_tpu.fleet.aggregator import FleetAggregator  # noqa: F401
+
+__all__ = [
+    "FLEET_TOPIC", "ROLLUP_TOPIC", "FleetDecodeError", "FleetSnapshot",
+    "decode_snapshot", "encode_snapshot", "SnapshotShipper",
+    "FleetAggregator",
+]
+
+
+def __getattr__(name: str):
+    # Lazy: the aggregator pulls in JAX, and the JAX-free half of this
+    # package (codec/shipper/hostsketch/node_agent) is exactly what the
+    # churn harness's 64+ child processes import — eager aggregator
+    # import would cost every child the full JAX startup.
+    if name == "FleetAggregator":
+        from retina_tpu.fleet.aggregator import FleetAggregator
+
+        return FleetAggregator
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -29,8 +29,10 @@ priority awareness, PAPERS.md) and the shed is counted.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
+from collections import deque
 from typing import Any
 
 import jax
@@ -55,6 +57,11 @@ from retina_tpu.utils import metric_names as mn
 ENTROPY_DIMS = ("src_ip", "dst_ip", "dst_port")
 _HH_FAMILIES = ("flow", "svc", "dns")
 
+# Seed-generation reference history kept per aggregator: a live seed
+# rotation is a handful of generations at most, and old generations'
+# references are useless once every node has rotated past them.
+_GEN_HISTORY = 8
+
 
 def format_key(row: np.ndarray) -> str:
     """Stable label rendering of one candidate key row (C u32 columns)."""
@@ -75,18 +82,46 @@ class FleetAggregator:
     """Thread-safe; ``ingest`` runs on transport threads (pubsub pool /
     gRPC handlers), ``poll`` on the internal timer thread."""
 
-    def __init__(self, cfg, supervisor=None) -> None:
+    def __init__(self, cfg, supervisor=None, reship_transport=None) -> None:
         self.cfg = cfg
         self.log = logger("fleet.agg")
         self._supervisor = supervisor
         self._lock = threading.Lock()
         self._buckets: dict[int, _EpochBucket] = {}
         self._watermark = -1  # highest CLOSED epoch
-        self._ref_seeds: dict[str, int] | None = None
-        self._ref_shapes: dict[str, tuple] | None = None
+        # Seed/shape references keyed by seed generation: a frame is
+        # validated against ITS OWN generation's reference, so a rotated
+        # node is never permanently quarantined — only a node whose
+        # seeds disagree with its generation's reference is dropped
+        # (``seed_mismatch``), which still catches real misconfig.
+        self._gen_refs: dict[
+            int, tuple[dict[str, int], dict[str, tuple]]
+        ] = {}
+        # Tier-2 re-ship: when configured, every merged epoch is
+        # re-encoded as a (valid, tier=1) node snapshot and shipped to
+        # the next rollup tier — the merge algebra is a semilattice, so
+        # the root aggregator folds zone rollups exactly like node
+        # frames. ``reship_transport`` injects a transport callable for
+        # tests/harnesses; otherwise cfg.fleet_reship_addr dials gRPC.
+        self._reshipper = None
+        if reship_transport is not None or str(cfg.fleet_reship_addr):
+            from retina_tpu.fleet.shipper import SnapshotShipper
+
+            ship_cfg = dataclasses.replace(
+                cfg, fleet_relay_addr=str(cfg.fleet_reship_addr)
+            )
+            self._reshipper = SnapshotShipper(
+                ship_cfg, supervisor=supervisor,
+                transport=reship_transport,
+            )
+            self._reshipper.tier = 1
         # jitted batched-merge executables keyed by (n_nodes, array
         # signature): re-lowering per epoch would dominate the merge.
         self._merge_cache: dict[Any, Any] = {}
+        # Quorum-closed buckets awaiting merge when fleet_merge_async is
+        # set: ingest only appends here (under the lock); the poll
+        # thread drains it ahead of straggler checks.
+        self._ready_q: deque[tuple[int, _EpochBucket]] = deque()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._sub_id: str | None = None
@@ -106,7 +141,10 @@ class FleetAggregator:
                 supervisor=supervisor,
             )
         # Rolling window of recent rollups for tests/dryrun/debug vars.
+        # The retention is a plain attribute so harnesses that score a
+        # fixed epoch window (fleet/churn.py) can widen it.
         self.rollups: list[dict] = []
+        self.rollups_keep = 64
         self.epochs_merged = 0
         # High-water mark of concurrently-open epoch buckets; staying
         # at or under cfg.fleet_epoch_history proves the overflow
@@ -137,9 +175,13 @@ class FleetAggregator:
                 target=self._poll_loop, name="fleet-agg", daemon=True
             )
             self._thread.start()
+        if self._reshipper is not None:
+            self._reshipper.start()
 
     def stop(self, timeout_s: float = 5.0) -> None:
         self._stop.set()
+        if self._reshipper is not None:
+            self._reshipper.stop(timeout_s=timeout_s)
         if self._sub_id is not None:
             from retina_tpu.fleet.codec import FLEET_TOPIC
 
@@ -191,18 +233,27 @@ class FleetAggregator:
             if snap.epoch <= self._watermark:
                 m.fleet_snapshots_dropped.labels(reason="late").inc()
                 return False
-            if self._ref_seeds is None:
-                self._ref_seeds = dict(snap.seeds)
-                self._ref_shapes = {
-                    k: v.shape for k, v in snap.arrays.items()
-                }
-            if snap.seeds != self._ref_seeds:
+            gen = int(snap.seed_gen)
+            ref = self._gen_refs.get(gen)
+            if ref is None:
+                # First frame of this generation defines its reference;
+                # bound the history so a node spraying bogus generations
+                # cannot grow this dict unboundedly.
+                while len(self._gen_refs) >= _GEN_HISTORY:
+                    del self._gen_refs[min(self._gen_refs)]
+                ref = (
+                    dict(snap.seeds),
+                    {k: v.shape for k, v in snap.arrays.items()},
+                )
+                self._gen_refs[gen] = ref
+            ref_seeds, ref_shapes = ref
+            if snap.seeds != ref_seeds:
                 m.fleet_snapshots_dropped.labels(
                     reason="seed_mismatch"
                 ).inc()
                 return False
             shapes = {k: v.shape for k, v in snap.arrays.items()}
-            if shapes != self._ref_shapes:
+            if shapes != ref_shapes:
                 m.fleet_snapshots_dropped.labels(
                     reason="shape_mismatch"
                 ).inc()
@@ -224,6 +275,11 @@ class FleetAggregator:
                 ready = [(snap.epoch, self._buckets.pop(snap.epoch))]
             else:
                 ready = self._overflow_locked()
+            if ready and self.cfg.fleet_merge_async:
+                # Hand closed buckets to the poll thread: the transport
+                # handler must not pay for the merge (or its compile).
+                self._ready_q.extend(ready)
+                ready = None
         for epoch, b in ready or ():
             try:
                 self._merge_epoch(epoch, b, straggled=False)
@@ -248,14 +304,20 @@ class FleetAggregator:
         number of epochs merged."""
         now = time.monotonic() if now is None else now
         timeout = self.cfg.fleet_straggler_timeout_s
-        ready: list[tuple[int, _EpochBucket]] = []
+        ready: list[tuple[int, _EpochBucket, bool]] = []
         with self._lock:
+            # Quorum-closed buckets deferred by ingest (fleet_merge_async)
+            # merge first — they are complete and older than any
+            # still-open straggler.
+            while self._ready_q:
+                epoch, bucket = self._ready_q.popleft()
+                ready.append((epoch, bucket, False))
             for epoch in sorted(self._buckets):
                 if now - self._buckets[epoch].first_t >= timeout:
-                    ready.append((epoch, self._buckets.pop(epoch)))
-        for epoch, bucket in ready:
+                    ready.append((epoch, self._buckets.pop(epoch), True))
+        for epoch, bucket, straggled in ready:
             try:
-                self._merge_epoch(epoch, bucket, straggled=True)
+                self._merge_epoch(epoch, bucket, straggled=straggled)
             except Exception:
                 get_metrics().fleet_merge_errors.inc()
                 if rate_limited("fleet.merge"):
@@ -315,6 +377,26 @@ class FleetAggregator:
         snaps = sorted(bucket.snaps.values(), key=lambda s: s.node)
         if not snaps:
             return
+        # Mid-rotation an epoch can hold frames from more than one seed
+        # generation. Cross-generation sketches don't merge, so take the
+        # dominant generation (ties break toward the NEWER one — the
+        # rotation target) and count the minority as per-epoch skew
+        # drops; those nodes re-admit next epoch, nothing is quarantined
+        # permanently.
+        by_gen: dict[int, list[FleetSnapshot]] = {}
+        for s in snaps:
+            by_gen.setdefault(int(s.seed_gen), []).append(s)
+        gen = max(by_gen, key=lambda g: (len(by_gen[g]), g))
+        if len(by_gen) > 1:
+            skewed = len(snaps) - len(by_gen[gen])
+            m.fleet_snapshots_dropped.labels(reason="gen_skew").inc(skewed)
+            if rate_limited("fleet.gen_skew"):
+                self.log.warning(
+                    "fleet epoch %d: %d frame(s) outside dominant seed "
+                    "generation %d dropped (rotation in flight)",
+                    epoch, skewed, gen,
+                )
+            snaps = by_gen[gen]
         # Cross-process lineage: the shipped trace context carries the
         # window-epoch trace ID from the node's close path; frames from
         # trace-less (older) nodes fall back to the epoch itself, which
@@ -353,8 +435,22 @@ class FleetAggregator:
             except Exception:
                 if rate_limited("fleet.ttring"):
                     self.log.exception("timetravel ring append failed")
+        if self._reshipper is not None:
+            # Re-ship the merged epoch one tier up: the merged arrays
+            # are themselves a valid node snapshot (same catalog, same
+            # dtypes — the algebra is closed under merge), so the next
+            # tier ingests this aggregator as if it were one big node.
+            self._reshipper.offer(
+                epoch,
+                {k: np.asarray(v) for k, v in merged.items()},
+                float(snaps[0].window_s),
+                dict(seeds),
+                seed_gen=gen,
+            )
+            m.fleet_rollups_reshipped.inc()
         rollup = self._rollup(epoch, snaps, merged, seeds)
         rollup["straggled"] = straggled
+        rollup["seed_gen"] = gen
         rollup["merge_seconds"] = time.monotonic() - t0
         self._publish(rollup)
         rec.record(mn.STAGE_AGG_MERGE, span_t0, trace_id)
@@ -365,7 +461,7 @@ class FleetAggregator:
         with self._lock:
             self.epochs_merged += 1
             self.rollups.append(rollup)
-            del self.rollups[:-64]
+            del self.rollups[:-self.rollups_keep]
 
     # -- rollup computation -------------------------------------------
     def _cluster_topk(
@@ -646,11 +742,16 @@ class FleetAggregator:
     # -- observability -------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "watermark": self._watermark,
                 "open_epochs": sorted(self._buckets),
+                "ready_q": len(self._ready_q),
                 "epochs_merged": self.epochs_merged,
+                "generations": sorted(self._gen_refs),
                 "nodes_last": (
                     self.rollups[-1]["nodes"] if self.rollups else []
                 ),
             }
+        if self._reshipper is not None:
+            out["reship"] = self._reshipper.stats()
+        return out

@@ -123,6 +123,18 @@ class FleetSnapshot:
     # compatible in both directions: old decoders ignore the unknown
     # msgpack key, this decoder tolerates its absence.
     trace: dict | None = None
+    # Seed generation: bumped by a live seed rotation. Sketches only
+    # merge within one generation; the aggregator quarantines
+    # cross-generation frames per epoch instead of permanently
+    # quarantining a rotated node. Same compatibility pattern as
+    # ``trace``: omitted from the wire when 0, so pre-rotation frames
+    # stay byte-identical and decode as generation 0.
+    seed_gen: int = 0
+    # Rollup tier of the ENCODER: 0 = node agent, 1 = zone aggregator
+    # re-ship, 2+ = higher tiers. Informational (the merge algebra is
+    # tier-blind — an aggregator's output is a valid node snapshot);
+    # omitted from the wire when 0.
+    tier: int = 0
 
     def nbytes(self) -> int:
         return sum(int(a.nbytes) for a in self.arrays.values())
@@ -163,6 +175,12 @@ def encode_snapshot(snap: FleetSnapshot) -> bytes:
         # Optional trace context: omitted entirely when unset so frames
         # from trace-less encoders stay byte-identical to v1-as-shipped.
         hdr["trace"] = snap.trace
+    if snap.seed_gen:
+        # Optional like trace: generation 0 frames stay byte-identical
+        # to pre-rotation v1 frames in both directions.
+        hdr["sgen"] = int(snap.seed_gen)
+    if snap.tier:
+        hdr["tier"] = int(snap.tier)
     header = msgpack.packb(hdr, use_bin_type=True)
     return b"".join(
         [MAGIC, bytes([VERSION]), struct.pack("<I", len(header)), header]
@@ -218,6 +236,8 @@ def decode_snapshot(frame: bytes) -> FleetSnapshot:
             arrays=arrays,
             trace=(dict(hdr["trace"])
                    if isinstance(hdr.get("trace"), dict) else None),
+            seed_gen=int(hdr.get("sgen", 0)),
+            tier=int(hdr.get("tier", 0)),
         )
     except (KeyError, TypeError, ValueError) as e:
         raise FleetDecodeError(f"bad header field: {e}") from e

@@ -13,6 +13,15 @@ under SHEDDING and above, the shipper backs off to shipping 1 window in
 ``fleet_shed_ship_every`` (the rollup is the cheapest remote work to
 lose; local scrape metrics stay complete).
 
+Delivery contract: a transport failure opens the send circuit and the
+frame goes to a bounded in-memory spool (oldest-evicted, both counted)
+instead of being lost. The worker retries with jittered exponential
+backoff — recreating the gRPC channel on each retry so a bounced relay
+is re-dialed fresh — and on heal replays the spool oldest-first before
+new frames, so a transient relay outage costs latency, not data. The
+circuit state is exported as a gauge (fleet_ship_circuit_open) and in
+:meth:`stats` — the node-local health signal operators alert on.
+
 Transport is pluggable: default is the in-process pubsub bus
 (FLEET_TOPIC — the aggregator subscribes when co-located); when
 ``fleet_relay_addr`` is set, frames go over the hubble relay's
@@ -23,8 +32,10 @@ from __future__ import annotations
 
 import os
 import queue as queue_mod
+import random
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -37,6 +48,9 @@ from retina_tpu.pubsub import get_pubsub
 from retina_tpu.runtime.overload import SHEDDING
 from retina_tpu.utils import metric_names as mn
 from retina_tpu.utils.device_proxy import fetch_on_device
+
+# Worker wake sentinel: a retry-timer tick, not a frame.
+_TICK = object()
 
 
 class SnapshotShipper:
@@ -56,6 +70,13 @@ class SnapshotShipper:
         )
         self.tenant = cfg.fleet_tenant
         self.priority = int(cfg.fleet_priority)
+        # Live seed generation: rotated by set_seed_generation (or per
+        # offer); tags every frame so the aggregator can tell a rotated
+        # node from a misconfigured one.
+        self.seed_gen = int(cfg.fleet_seed_generation)
+        # Tier stamped on outgoing frames (0 = node agent; the
+        # aggregator's re-shipper sets 1).
+        self.tier = 0
         self._overload = overload
         self._supervisor = supervisor
         self._transport = transport
@@ -69,6 +90,16 @@ class SnapshotShipper:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.shipped = 0  # frames actually sent (tests/dryrun)
+        # -- spool / circuit state (worker thread only, read by stats) --
+        self._spool: deque[bytes] = deque()
+        self._spool_cap = max(0, int(cfg.fleet_ship_spool))
+        self.circuit_open = False
+        self._fail_streak = 0
+        self._next_retry_t = 0.0
+        self.spooled = 0
+        self.spool_evicted = 0
+        self.spool_replayed = 0
+        self.reconnects = 0
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -89,6 +120,12 @@ class SnapshotShipper:
             self._supervisor.deregister(f"fleet-ship-{self.node}")
         self._thread = None
 
+    def set_seed_generation(self, gen: int) -> None:
+        """Rotate the live seed generation (tags frames from the NEXT
+        offer on; in-flight frames keep the generation they were built
+        under). Single int write — safe from any thread."""
+        self.seed_gen = int(gen)
+
     # -- close-path entry (device-proxy thread; must never block) ------
     def offer(
         self,
@@ -96,6 +133,7 @@ class SnapshotShipper:
         arrays: dict[str, Any],
         window_s: float,
         seeds: dict[str, int],
+        seed_gen: int | None = None,
     ) -> bool:  # runs-on: device-proxy
         """Enqueue one window's export for shipping. ``arrays`` values
         may be device arrays (fetched on the worker) or host numpy.
@@ -113,8 +151,9 @@ class SnapshotShipper:
             if count % every != 0:
                 m.fleet_ship_deferred.inc()
                 return False
+        gen = self.seed_gen if seed_gen is None else int(seed_gen)
         try:
-            self._q.put_nowait((epoch, arrays, window_s, seeds))
+            self._q.put_nowait((epoch, arrays, window_s, seeds, gen))
             return True
         except queue_mod.Full:
             m.fleet_ship_dropped.inc()
@@ -134,13 +173,27 @@ class SnapshotShipper:
         while not self._stop.is_set():
             if hb is not None:
                 hb.park()
-            item = self._q.get()
+            # With frames waiting in the spool, wake at the next retry
+            # time even if the queue stays empty — the replay must not
+            # depend on new window closes arriving.
+            timeout = None
+            if self._spool:
+                timeout = max(
+                    0.01, self._next_retry_t - time.monotonic()
+                )
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue_mod.Empty:
+                item = _TICK
             if item is None or self._stop.is_set():
                 break
             if hb is not None:
                 hb.beat()
             try:
-                self._ship_one(*item)
+                if item is _TICK:
+                    self._try_drain()
+                else:
+                    self._ship_one(*item)
             except Exception:
                 get_metrics().fleet_ship_errors.inc()
                 if rate_limited("fleet.ship"):
@@ -152,6 +205,7 @@ class SnapshotShipper:
         arrays: dict[str, Any],
         window_s: float,
         seeds: dict[str, int],
+        seed_gen: int = 0,
     ) -> None:
         rec = get_recorder()
         t0 = rec.begin()
@@ -173,17 +227,108 @@ class SnapshotShipper:
             # aggregator's merge span joins this lineage across the
             # process boundary (docs/observability.md).
             trace={"tid": int(epoch), "node": self.node},
+            seed_gen=int(seed_gen),
+            tier=int(self.tier),
         )
         t0 = rec.begin()
         frame = encode_snapshot(snap)
         rec.record(mn.STAGE_SHIP_ENCODE, t0, int(epoch))
         t0 = rec.begin()
-        self._send(frame)
+        self._deliver(frame)
         rec.record(mn.STAGE_SHIP_SEND, t0, int(epoch))
+
+    # -- delivery: circuit + spool + backoff ---------------------------
+    def _deliver(self, frame: bytes) -> None:
+        """Send one fresh frame, preserving epoch order: with frames
+        already spooled the new frame queues BEHIND them (and a drain is
+        attempted if the retry timer expired); otherwise it is sent
+        directly and spooled on failure."""
+        if self._spool:
+            self._spool_frame(frame)
+            self._try_drain()
+            return
+        try:
+            self._send(frame)
+        except Exception:
+            self._note_send_failure(frame_lost=False)
+            self._spool_frame(frame)
+            return
+        self._note_send_ok(len(frame))
+
+    def _try_drain(self) -> None:
+        """Replay the spool oldest-first once the backoff timer allows;
+        a failure re-arms the timer and keeps the remaining frames."""
+        if not self._spool or time.monotonic() < self._next_retry_t:
+            return
+        while self._spool:
+            frame = self._spool[0]
+            try:
+                self._send(frame)
+            except Exception:
+                self._note_send_failure(frame_lost=False)
+                return
+            self._spool.popleft()
+            self.spool_replayed += 1
+            get_metrics().fleet_ship_spool_replayed.inc()
+            self._note_send_ok(len(frame))
+
+    def _spool_frame(self, frame: bytes) -> None:
+        m = get_metrics()
+        if self._spool_cap <= 0:
+            # Spooling disabled: the legacy drop-on-error behavior
+            # (the failure itself was already counted as a ship error).
+            return
+        while len(self._spool) >= self._spool_cap:
+            self._spool.popleft()  # oldest-evicted
+            self.spool_evicted += 1
+            m.fleet_ship_spool_evicted.inc()
+        self._spool.append(frame)
+        self.spooled += 1
+        m.fleet_ship_spooled.inc()
+
+    def _note_send_ok(self, nbytes: int) -> None:
         m = get_metrics()
         m.fleet_snapshots_shipped.inc()
-        m.fleet_ship_bytes.inc(len(frame))
+        m.fleet_ship_bytes.inc(nbytes)
         self.shipped += 1
+        if self.circuit_open:
+            self.log.info(
+                "fleet ship circuit closed after %d failures "
+                "(%d frames spooled)", self._fail_streak, len(self._spool),
+            )
+        self.circuit_open = False
+        self._fail_streak = 0
+        m.fleet_ship_circuit_open.set(0.0)
+
+    def _note_send_failure(self, frame_lost: bool) -> None:
+        m = get_metrics()
+        m.fleet_ship_errors.inc()
+        self._fail_streak += 1
+        self.circuit_open = True
+        m.fleet_ship_circuit_open.set(1.0)
+        # Jittered exponential backoff: full-jitter style (uniform in
+        # [base/2, backoff]) so a fleet of nodes cut off by one relay
+        # outage does not re-dial in lockstep on heal.
+        base = max(1e-3, float(self.cfg.fleet_ship_backoff_base_s))
+        cap = max(base, float(self.cfg.fleet_ship_backoff_max_s))
+        backoff = min(cap, base * (2.0 ** min(self._fail_streak - 1, 16)))
+        delay = random.uniform(base / 2.0, backoff)
+        self._next_retry_t = time.monotonic() + delay
+        # A failed gRPC channel is torn down so the next attempt
+        # re-dials (the relay may have restarted on the same address
+        # with a new socket).
+        if self._grpc_client is not None:
+            try:
+                self._grpc_client.close()
+            except Exception:  # noqa: RT101 — best-effort channel teardown
+                pass
+            self._grpc_client = None
+        if rate_limited("fleet.ship_circuit"):
+            self.log.warning(
+                "fleet ship failed (streak %d); retry in %.3fs, "
+                "%d frames spooled", self._fail_streak, delay,
+                len(self._spool),
+            )
 
     def _send(self, frame: bytes) -> None:
         if self._transport is not None:
@@ -196,6 +341,9 @@ class SnapshotShipper:
                 # (same gating as hubble/server.py).
                 from retina_tpu.hubble.server import FleetShipClient
 
+                if self._fail_streak:
+                    self.reconnects += 1
+                    get_metrics().fleet_ship_reconnects.inc()
                 self._grpc_client = FleetShipClient(addr)
             self._grpc_client.ship(frame)
             return
@@ -209,6 +357,13 @@ class SnapshotShipper:
             "seq": self._seq,
             "shipped": self.shipped,
             "queue_depth": self._q.qsize(),
+            "seed_gen": self.seed_gen,
+            "circuit_open": self.circuit_open,
+            "spool_depth": len(self._spool),
+            "spooled": self.spooled,
+            "spool_evicted": self.spool_evicted,
+            "spool_replayed": self.spool_replayed,
+            "reconnects": self.reconnects,
         }
 
 

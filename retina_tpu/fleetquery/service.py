@@ -41,6 +41,7 @@ import concurrent.futures
 import json
 import threading
 import time
+from collections import Counter
 from typing import Any
 
 from retina_tpu.fleet.aggregator import format_key
@@ -333,9 +334,17 @@ class FleetQueryService:
 
         # Seed agreement: sketches only merge under one seed set; a
         # misconfigured node's arrays would silently corrupt the fold.
-        seeds = next(
-            (r["seeds"] for r in results if r["arrays"] is not None), {}
+        # MAJORITY vote, not first-answerer: mid-rotation the fold
+        # follows whichever seed set most answering nodes hold, so a
+        # rotated fleet re-admits as soon as the majority flips instead
+        # of being held hostage by one stale (or fast) first responder.
+        # Ties break deterministically on the serialized seed set.
+        tally = Counter(
+            tuple(sorted(r["seeds"].items()))
+            for r in results if r["arrays"] is not None
         )
+        winner = max(tally, key=lambda s: (tally[s], s), default=())
+        seeds = dict(winner)
         parts: list[dict] = []
         epochs: set[int] = set()
         for r in results:

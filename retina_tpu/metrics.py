@@ -139,6 +139,19 @@ class Metrics:
         self.fleet_ship_deferred = c(mn.FLEET_SHIP_DEFERRED, [])
         self.fleet_ship_dropped = c(mn.FLEET_SHIP_DROPPED, [])
         self.fleet_ship_errors = c(mn.FLEET_SHIP_ERRORS, [])
+        # Send-failure survival (fleet/shipper.py): spool occupancy
+        # events, the replay on heal, channel re-dials, and the
+        # circuit-open health gauge (1 while the relay is unreachable).
+        self.fleet_ship_spooled = c(mn.FLEET_SHIP_SPOOLED, [])
+        self.fleet_ship_spool_evicted = c(mn.FLEET_SHIP_SPOOL_EVICTED, [])
+        self.fleet_ship_spool_replayed = c(
+            mn.FLEET_SHIP_SPOOL_REPLAYED, []
+        )
+        self.fleet_ship_reconnects = c(mn.FLEET_SHIP_RECONNECTS, [])
+        self.fleet_ship_circuit_open = g(mn.FLEET_SHIP_CIRCUIT_OPEN, [])
+        # Two-level rollup: merged epochs re-shipped to the parent
+        # (root) aggregator.
+        self.fleet_rollups_reshipped = c(mn.FLEET_ROLLUPS_RESHIPPED, [])
         # Operator-side aggregator:
         self.fleet_snapshots_received = c(
             mn.FLEET_SNAPSHOTS_RECEIVED, [mn.L_NODE]

@@ -6,5 +6,23 @@ hash maps (e.g. drop_reason.c:88-94) and the single-threaded Go
 the scaling bottleneck) — with jit-compiled vectorized kernels.
 """
 
-from retina_tpu.ops.hashing import fmix32, hash_cols, hash_family, flow_key_hash64  # noqa: F401
-from retina_tpu.ops.countmin import CountMinSketch  # noqa: F401
+__all__ = [
+    "fmix32", "hash_cols", "hash_family", "flow_key_hash64",
+    "CountMinSketch",
+]
+
+
+def __getattr__(name: str):
+    # Lazy: every kernel module imports JAX, but this package also
+    # hosts the JAX-free host mirrors (ops/hashing_np.py) that the
+    # fleet churn harness's child processes import — an eager kernel
+    # import here would drag JAX into every child.
+    if name in ("fmix32", "hash_cols", "hash_family", "flow_key_hash64"):
+        from retina_tpu.ops import hashing
+
+        return getattr(hashing, name)
+    if name == "CountMinSketch":
+        from retina_tpu.ops.countmin import CountMinSketch
+
+        return CountMinSketch
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

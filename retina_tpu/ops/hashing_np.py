@@ -1,0 +1,47 @@
+"""Pure-numpy mirrors of the device hash family (JAX-free module).
+
+Split out of ops/hashing.py so host-only processes — the churn
+harness's node-agent children (fleet/hostsketch.py, fleet/node_agent.py)
+and host-side table builders — can compute device-identical hashes
+without importing JAX at all (seconds of startup and hundreds of MB per
+process). ops/hashing.py re-exports these names, so existing imports
+keep working; the device and host implementations are pinned
+bit-identical by tests/test_hashing.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Golden-ratio-derived odd constant (Weyl sequence) seeding the family.
+_PHI32 = np.uint32(0x9E3779B9)
+
+
+def fmix32_np(x: np.ndarray) -> np.ndarray:
+    """murmur3 fmix32 finalizer, bit-identical to the device fmix32."""
+    x = x.astype(np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    x = (x * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    x = x ^ (x >> np.uint32(13))
+    x = (x * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def hash_cols_np(cols: list[np.ndarray], seed) -> np.ndarray:
+    """Bit-identical mirror of the device hash_cols combine chain."""
+    h = (np.asarray(seed, np.uint32) * _PHI32).astype(np.uint32)
+    for c in cols:
+        c = np.asarray(c, np.uint32)
+        h = fmix32_np(
+            h ^ (c + _PHI32 + (h << np.uint32(6)) + (h >> np.uint32(2))).astype(
+                np.uint32
+            )
+        )
+    return h
+
+
+def reduce_range_np(h: np.ndarray, width: int) -> np.ndarray:
+    """Mask uint32 hashes onto [0, width), width a power of two."""
+    assert width & (width - 1) == 0, f"width must be a power of two, got {width}"
+    return h & np.uint32(width - 1)

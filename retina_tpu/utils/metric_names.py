@@ -155,6 +155,12 @@ FLEET_SHIP_BYTES = FLEET_PREFIX + "ship_bytes_counter"
 FLEET_SHIP_DEFERRED = FLEET_PREFIX + "ship_deferred_counter"
 FLEET_SHIP_DROPPED = FLEET_PREFIX + "ship_dropped_counter"
 FLEET_SHIP_ERRORS = FLEET_PREFIX + "ship_errors_counter"
+FLEET_SHIP_SPOOLED = FLEET_PREFIX + "ship_spooled_counter"
+FLEET_SHIP_SPOOL_EVICTED = FLEET_PREFIX + "ship_spool_evicted_counter"
+FLEET_SHIP_SPOOL_REPLAYED = FLEET_PREFIX + "ship_spool_replayed_counter"
+FLEET_SHIP_RECONNECTS = FLEET_PREFIX + "ship_reconnects_counter"
+FLEET_SHIP_CIRCUIT_OPEN = FLEET_PREFIX + "ship_circuit_open"
+FLEET_ROLLUPS_RESHIPPED = FLEET_PREFIX + "rollups_reshipped_counter"
 FLEET_SNAPSHOTS_RECEIVED = FLEET_PREFIX + "snapshots_received_counter"
 FLEET_SNAPSHOTS_DROPPED = FLEET_PREFIX + "snapshots_dropped_counter"
 FLEET_WINDOWS_MERGED = FLEET_PREFIX + "windows_merged_counter"

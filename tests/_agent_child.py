@@ -14,11 +14,11 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 import threading  # noqa: E402
-import time  # noqa: E402
 
 from retina_tpu.common import RetinaEndpoint, RetinaNode  # noqa: E402
 from retina_tpu.config import Config  # noqa: E402
 from retina_tpu.daemon import Daemon  # noqa: E402
+from tests.procutil import wait_until  # noqa: E402
 
 
 def main() -> None:
@@ -53,11 +53,10 @@ def main() -> None:
     stop = threading.Event()
     t = threading.Thread(target=d.start, args=(stop,), daemon=True)
     t.start()
-    deadline = time.monotonic() + 60
-    while time.monotonic() < deadline:
-        if d.observer is not None and d.observer.flows_seen > 0:
-            break
-        time.sleep(0.1)
+    wait_until(
+        lambda: d.observer is not None and d.observer.flows_seen > 0,
+        deadline_s=60.0, poll_s=0.1,
+    )
     print(f"HUBBLE_PORT={d.hubble.port}", flush=True)
     # Block until the parent closes our stdin.
     sys.stdin.read()

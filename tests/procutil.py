@@ -1,0 +1,99 @@
+"""Deadline-based readiness helpers for multi-process tests.
+
+Flaky pattern this replaces: a parent calling ``proc.stdout.readline()``
+in a loop with a wall-clock check BETWEEN reads. ``readline`` itself
+blocks indefinitely, so a wedged child turns the "deadline" into a hang
+that only pytest's (much larger) global timeout catches — and a child
+that dies without output makes the loop spin on empty strings. Here a
+daemon reader thread owns the pipe and the parent blocks on events with
+real timeouts, so every wait is bounded by construction and failures
+carry the child's actual output.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from typing import Callable
+
+
+def wait_until(predicate: Callable[[], bool], deadline_s: float,
+               poll_s: float = 0.05) -> bool:
+    """Poll ``predicate`` until true or the deadline lapses (one final
+    check at the deadline so a slow scheduler can't fail a passed
+    condition)."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll_s)
+    return predicate()
+
+
+class LineReader:
+    """Own a child's stdout on a daemon thread; expose bounded waits.
+
+    - :meth:`expect` blocks (with a deadline) for the first line
+      starting with a prefix and returns it, or raises ``TimeoutError``
+      carrying everything the child said so far — the failure message a
+      flake investigation actually needs.
+    - All lines are retained in :attr:`lines` for post-hoc assertions.
+    - EOF (child exit or pipe close) wakes every waiter immediately
+      instead of leaving them to ride out their full deadline.
+    """
+
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+        self.lines: list[str] = []
+        self.eof = threading.Event()
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(
+            target=self._run, name="procutil-reader", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            for line in self.proc.stdout:
+                with self._cond:
+                    self.lines.append(line.rstrip("\n"))
+                    self._cond.notify_all()
+        finally:
+            with self._cond:
+                self.eof.set()
+                self._cond.notify_all()
+
+    def expect(self, prefix: str, deadline_s: float) -> str:
+        """Return the first line starting with ``prefix``; bounded."""
+        deadline = time.monotonic() + deadline_s
+        scanned = 0
+        with self._cond:
+            while True:
+                for line in self.lines[scanned:]:
+                    if line.startswith(prefix):
+                        return line
+                scanned = len(self.lines)
+                remaining = deadline - time.monotonic()
+                if self.eof.is_set() or remaining <= 0:
+                    raise TimeoutError(
+                        f"no line starting with {prefix!r} "
+                        f"(eof={self.eof.is_set()}, rc={self.proc.poll()}); "
+                        f"child said: {self.lines!r}"
+                    )
+                self._cond.wait(min(remaining, 0.5))
+
+
+def stop_child(proc: subprocess.Popen, deadline_s: float = 10.0) -> int:
+    """Close stdin (the conventional stop signal for these children)
+    and reap within a bound; escalate to kill rather than hang."""
+    try:
+        if proc.stdin is not None:
+            proc.stdin.close()
+    except OSError:
+        pass
+    try:
+        return proc.wait(timeout=deadline_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return proc.wait()

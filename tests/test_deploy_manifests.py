@@ -258,7 +258,9 @@ def test_ci_workflow_coherent():
                         "workflows", "test.yaml")
     with open(path) as fh:
         wf = _yaml.safe_load(fh)
-    assert set(wf["jobs"]) == {"unit", "bench-smoke", "manifests"}
+    assert set(wf["jobs"]) == {
+        "unit", "bench-smoke", "churn-smoke", "manifests",
+    }
     steps = [s for j in wf["jobs"].values() for s in j["steps"]]
     runs = "\n".join(s.get("run", "") for s in steps)
     # Every file/target the workflow invokes exists.

@@ -1,0 +1,266 @@
+"""Churn-hardening coverage (ISSUE 19 satellite): RFLT codec
+forward/backward compatibility, shipper spool/backoff/circuit behavior,
+tier-2 re-ship idempotence, and seed-rotation re-admission.
+
+The compatibility contract under test: optional header keys ("trace",
+"sgen", "tier") are OMITTED when unset — a pre-rotation encoder and a
+current encoder produce byte-identical frames for generation-0
+snapshots — and unknown header keys are ignored on decode, so frames
+flow between old and new binaries in both directions during a rolling
+fleet upgrade.
+"""
+
+import struct
+
+import msgpack
+import numpy as np
+import pytest
+
+from retina_tpu.config import Config
+from retina_tpu.fleet.aggregator import FleetAggregator
+from retina_tpu.fleet.codec import (
+    FleetSnapshot, decode_snapshot, encode_snapshot,
+)
+from retina_tpu.fleet.hostsketch import rotated_seeds, sketch_arrays_np
+from retina_tpu.fleet.shipper import SnapshotShipper
+from tests.procutil import wait_until
+
+
+def _arrays(node_idx: int = 0, gen: int = 0, b: int = 32):
+    rng = np.random.default_rng(1000 + node_idx)
+    keys = rng.integers(0, 2**32, size=(b, 4), dtype=np.uint32)
+    w = rng.integers(1, 100, size=b, dtype=np.uint32)
+    return sketch_arrays_np(keys, w, rotated_seeds(gen))
+
+
+def _snap(node="n0", epoch=7, gen=0, tier=0, trace=None, seq=1):
+    return FleetSnapshot(
+        node=node, tenant="default", priority=0, epoch=epoch, seq=seq,
+        window_s=1.0, seeds=dict(rotated_seeds(gen)),
+        arrays=_arrays(gen=gen), trace=trace, seed_gen=gen, tier=tier,
+    )
+
+
+def _rewrite_header(frame: bytes, mutate) -> bytes:
+    """Re-pack a frame's msgpack header after ``mutate(hdr)`` — how the
+    tests impersonate older/newer encoders on the same payload."""
+    (hlen,) = struct.unpack_from("<I", frame, 5)
+    hdr = msgpack.unpackb(frame[9:9 + hlen], raw=False)
+    mutate(hdr)
+    new = msgpack.packb(hdr, use_bin_type=True)
+    return frame[:5] + struct.pack("<I", len(new)) + new + frame[9 + hlen:]
+
+
+def _header(frame: bytes) -> dict:
+    (hlen,) = struct.unpack_from("<I", frame, 5)
+    return msgpack.unpackb(frame[9:9 + hlen], raw=False)
+
+
+# -- codec forward/backward compatibility ------------------------------
+def test_sgen_and_tier_round_trip():
+    back = decode_snapshot(encode_snapshot(_snap(gen=3, tier=1)))
+    assert back.seed_gen == 3
+    assert back.tier == 1
+
+
+def test_gen0_tier0_frames_omit_optional_keys():
+    """A generation-0, tier-0, trace-less frame must not carry the
+    optional keys at all — byte-identical to what a pre-rotation
+    encoder shipped, so old decoders that reject unknown keys (none of
+    ours do, but the wire contract shouldn't depend on that) never see
+    them."""
+    hdr = _header(encode_snapshot(_snap()))
+    assert "sgen" not in hdr
+    assert "tier" not in hdr
+    assert "trace" not in hdr
+
+
+def test_decoder_ignores_unknown_header_keys():
+    """Forward compat: a NEWER encoder adds a header key this decoder
+    has never heard of — the frame must still decode, payload exact."""
+    snap = _snap(gen=1, tier=1)
+    frame = _rewrite_header(
+        encode_snapshot(snap),
+        lambda h: h.update(x_future={"hint": 1}, x_more=[1, 2]),
+    )
+    back = decode_snapshot(frame)
+    assert back.node == snap.node
+    assert back.epoch == snap.epoch
+    assert back.seed_gen == 1
+    assert back.tier == 1
+    for name, arr in snap.arrays.items():
+        np.testing.assert_array_equal(back.arrays[name], arr)
+
+
+def test_decoder_defaults_missing_optional_keys():
+    """Backward compat: an OLDER encoder never writes sgen/tier/trace —
+    stripping them must decode as generation 0, tier 0, no trace."""
+    frame = _rewrite_header(
+        encode_snapshot(_snap(gen=2, tier=1, trace={"tid": 9})),
+        lambda h: [h.pop(k, None) for k in ("sgen", "tier", "trace")],
+    )
+    back = decode_snapshot(frame)
+    assert back.seed_gen == 0
+    assert back.tier == 0
+    assert back.trace is None
+
+
+# -- shipper spool / backoff / circuit ---------------------------------
+class _SwitchTransport:
+    def __init__(self):
+        self.down = True
+        self.frames: list[bytes] = []
+        self.attempts = 0
+
+    def __call__(self, frame: bytes) -> None:
+        self.attempts += 1
+        if self.down:
+            raise ConnectionError("scripted outage")
+        self.frames.append(frame)
+
+
+def _ship_cfg(**kw):
+    return Config(
+        fleet_enabled=True, fleet_node_name="s0",
+        fleet_ship_backoff_base_s=0.01, fleet_ship_backoff_max_s=0.05,
+        **kw,
+    )
+
+
+def test_shipper_spools_during_outage_and_replays_in_order():
+    tr = _SwitchTransport()
+    ship = SnapshotShipper(_ship_cfg(fleet_ship_spool=8), transport=tr)
+    ship.start()
+    try:
+        seeds = rotated_seeds(0)
+        for e in (101, 102):
+            assert ship.offer(e, _arrays(), 1.0, seeds)
+        assert wait_until(
+            lambda: ship.stats()["spool_depth"] == 2, deadline_s=10.0
+        ), ship.stats()
+        st = ship.stats()
+        assert st["circuit_open"], "outage must open the circuit"
+        assert tr.attempts >= 2, "backoff must keep retrying"
+
+        tr.down = False  # heal: spool replays oldest-first, then closes
+        assert wait_until(
+            lambda: ship.stats()["spool_replayed"] == 2
+            and ship.stats()["spool_depth"] == 0, deadline_s=10.0
+        ), ship.stats()
+        assert not ship.stats()["circuit_open"]
+        epochs = [decode_snapshot(f).epoch for f in tr.frames]
+        assert epochs == [101, 102], "replay must preserve ship order"
+    finally:
+        ship.stop()
+
+
+def test_shipper_spool_bounded_evicts_oldest_counted():
+    tr = _SwitchTransport()
+    ship = SnapshotShipper(_ship_cfg(fleet_ship_spool=3), transport=tr)
+    ship.start()
+    try:
+        seeds = rotated_seeds(0)
+        for e in range(201, 207):  # 6 frames into a 3-deep spool
+            ship.offer(e, _arrays(), 1.0, seeds)
+            wait_until(
+                lambda: ship.stats()["queue_depth"] == 0, deadline_s=5.0
+            )
+        st = ship.stats()
+        assert st["spool_depth"] <= 3
+        assert st["spool_evicted"] >= 3, st
+        tr.down = False
+        assert wait_until(
+            lambda: ship.stats()["spool_depth"] == 0, deadline_s=10.0
+        )
+        # The frames that survived are the NEWEST ones.
+        assert [decode_snapshot(f).epoch for f in tr.frames] == [
+            204, 205, 206,
+        ]
+    finally:
+        ship.stop()
+
+
+# -- tier-2 re-ship idempotence ----------------------------------------
+@pytest.fixture(scope="module")
+def zone_reship_frame():
+    """One real zone rollup captured off the re-ship path (module-scoped:
+    the merge jit compile is the expensive part)."""
+    captured: list[bytes] = []
+    cfg = Config(
+        fleet_enabled=True, fleet_aggregator=True, fleet_expected_nodes=2,
+        fleet_straggler_timeout_s=5.0, fleet_node_name="zoneA",
+    )
+    agg = FleetAggregator(cfg, reship_transport=captured.append)
+    agg.start(subscribe=False)
+    try:
+        for i in range(2):
+            snap = _snap(node=f"n{i}", epoch=42, seq=1)
+            snap.arrays = _arrays(node_idx=i)
+            assert agg.ingest(encode_snapshot(snap))
+        assert wait_until(lambda: len(captured) == 1, deadline_s=30.0)
+    finally:
+        agg.stop()
+    return captured[0]
+
+
+def test_reship_frame_is_valid_node_snapshot(zone_reship_frame):
+    """The semilattice contract end-to-end: an aggregator's output IS a
+    node snapshot — same codec, same catalog, tier bumped."""
+    back = decode_snapshot(zone_reship_frame)
+    assert back.node == "zoneA"
+    assert back.tier == 1
+    assert back.epoch == 42
+    assert back.seeds == rotated_seeds(0)
+    # Re-encoding the decoded snapshot must be byte-stable (sorted-name
+    # array order makes encoding deterministic).
+    assert encode_snapshot(back) == zone_reship_frame
+
+
+def test_double_ingest_same_epoch_is_counted_noop(zone_reship_frame):
+    root = FleetAggregator(Config(
+        fleet_enabled=True, fleet_aggregator=True, fleet_expected_nodes=1,
+        fleet_straggler_timeout_s=5.0, fleet_node_name="root",
+    ))
+    try:
+        assert root.ingest(zone_reship_frame)
+        assert wait_until(lambda: len(root.rollups) == 1, deadline_s=30.0)
+        # Same frame again: a counted reject (late/duplicate), not a
+        # second rollup and not an error.
+        assert not root.ingest(zone_reship_frame)
+        assert len(root.rollups) == 1
+        assert root.rollups[0]["nodes"] == ["zoneA"]
+    finally:
+        root.stop()
+
+
+# -- seed rotation re-admission ----------------------------------------
+def test_rotation_quarantines_epoch_not_node():
+    """Mid-rotation epoch: the minority-generation frame is dropped for
+    THAT epoch only; next epoch the rotated node is back in the merge —
+    quarantine is per-(epoch, generation), never permanent."""
+    agg = FleetAggregator(Config(
+        fleet_enabled=True, fleet_aggregator=True, fleet_expected_nodes=3,
+        fleet_straggler_timeout_s=5.0, fleet_node_name="zoneR",
+    ))
+    try:
+        # Epoch 50: n0 still on gen 0, n1/n2 already rotated to gen 1.
+        for node, gen in (("n0", 0), ("n1", 1), ("n2", 1)):
+            s = _snap(node=node, epoch=50, gen=gen, seq=1)
+            agg.ingest(encode_snapshot(s))
+        assert wait_until(lambda: len(agg.rollups) == 1, deadline_s=30.0)
+        r = agg.rollups[0]
+        assert r["seed_gen"] == 1, "majority generation must win"
+        assert set(r["nodes"]) == {"n1", "n2"}
+
+        # Epoch 51: n0 finished rotating — full quorum at gen 1.
+        for node in ("n0", "n1", "n2"):
+            s = _snap(node=node, epoch=51, gen=1, seq=2)
+            agg.ingest(encode_snapshot(s))
+        assert wait_until(lambda: len(agg.rollups) == 2, deadline_s=30.0)
+        r = agg.rollups[1]
+        assert r["seed_gen"] == 1
+        assert set(r["nodes"]) == {"n0", "n1", "n2"}, (
+            "rotated node must be re-admitted"
+        )
+    finally:
+        agg.stop()

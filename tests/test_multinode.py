@@ -10,7 +10,6 @@ reflects its node store, which B's discovery loop consumes.
 
 import subprocess
 import sys
-import time
 from pathlib import Path
 
 import grpc
@@ -18,6 +17,7 @@ import pytest
 
 from retina_tpu.hubble import proto as pb
 from retina_tpu.hubble.relay import HubbleRelay
+from tests.procutil import LineReader, stop_child, wait_until
 
 REPO = str(Path(__file__).resolve().parent.parent)
 
@@ -29,22 +29,12 @@ def agent_a():
          REPO, "node-a"],
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
     )
+    reader = LineReader(proc)
     try:
-        port = None
-        deadline = time.monotonic() + 120
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline()
-            if line.startswith("HUBBLE_PORT="):
-                port = int(line.strip().split("=")[1])
-                break
-            if proc.poll() is not None:
-                raise RuntimeError("agent child died")
-        assert port, "agent child never reported its port"
-        yield port
+        line = reader.expect("HUBBLE_PORT=", deadline_s=120.0)
+        yield int(line.split("=")[1])
     finally:
-        if proc.poll() is None:
-            proc.stdin.close()
-            proc.wait(timeout=10)
+        stop_child(proc)
 
 
 def test_flow_from_agent_a_visible_via_relay_b(agent_a):
@@ -56,10 +46,9 @@ def test_flow_from_agent_a_visible_via_relay_b(agent_a):
     relay.start()
     try:
         # Flows ingested in process A must reach B's local ring.
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline and relay.observer.flows_seen == 0:
-            time.sleep(0.2)
-        assert relay.observer.flows_seen > 0, "no flows crossed processes"
+        assert wait_until(
+            lambda: relay.observer.flows_seen > 0, deadline_s=30.0
+        ), "no flows crossed processes"
 
         # And be served from B's own Cilium-compatible surface, with A's
         # node attribution preserved.
@@ -108,9 +97,9 @@ def test_relay_discovery_via_peer_service(agent_a):
     )
     relay.start()
     try:
-        deadline = time.monotonic() + 15
-        while time.monotonic() < deadline and not relay._connected:
-            time.sleep(0.2)
+        assert wait_until(
+            lambda: bool(relay._connected), deadline_s=15.0, poll_s=0.2
+        )
         assert f"10.99.0.7:{agent_a}" in relay._connected
     finally:
         relay.stop()
