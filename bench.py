@@ -395,6 +395,41 @@ def _measure_link_bandwidth() -> float:
     return sorted(rates)[1]
 
 
+def wait_bucket_warm(
+    eng, deadline_s: float, emit=log, sleep_s: float = 0.5,
+) -> tuple[float | None, bool]:
+    """Wait for the background bucket-grid warm to reach a TERMINAL
+    state, polling BOTH events: a failed warm sets bucket_warm_failed
+    and never sets bucket_warm_done, so waiting on done alone would
+    burn the full deadline before measuring a system that already
+    knows some keys will cold-compile mid-window.
+
+    Returns ``(bucket_warm_s, warm_incomplete)``: seconds until the
+    warm completed (None when it failed — some keys WILL cold-compile
+    mid-measurement), and True when the deadline passed with the warm
+    still running (measurement windows are warm-contaminated)."""
+    t_warm = time.monotonic()
+    while time.monotonic() - t_warm < deadline_s:
+        if eng.bucket_warm_failed.is_set():
+            emit("e2e: WARNING bucket grid warm FAILED "
+                 f"{time.monotonic() - t_warm:.0f}s after first "
+                 "traffic; some keys will cold-compile mid-measurement")
+            return None, False
+        if eng.bucket_warm_done.is_set():
+            dt = time.monotonic() - t_warm
+            emit(f"e2e: bucket grid warm complete "
+                 f"{dt:.0f}s after first traffic")
+            return dt, False
+        time.sleep(sleep_s)
+    # Deadline hit with the warm still running: record how long it had
+    # been going when measurement started (a null here used to erase
+    # the fact that the warm consumed the whole budget — BENCH diag
+    # satellite, PR 13) and flag the window as warm-contaminated.
+    emit(f"e2e: WARNING bucket grid warm not done after "
+         f"{deadline_s:.0f}s; measuring anyway")
+    return time.monotonic() - t_warm, True
+
+
 def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     """Full-system benchmark: boot the REAL agent (daemon: plugins ->
     sink -> combine/pack/partition feed -> device step -> metrics module
@@ -592,35 +627,7 @@ def run_e2e(smoke: bool, duration_s: float | None = None) -> dict:
     # measure windows into compile-stall weather (the agent is READY and
     # serving throughout — this wait is about what the windows measure,
     # not about boot latency, which is reported above).
-    t_warm = time.monotonic()
-    # Poll BOTH terminal warm events: a failed warm sets
-    # bucket_warm_failed and never sets bucket_warm_done, so waiting on
-    # done alone would burn the full 600s cap before measuring a system
-    # that already knows some keys will cold-compile mid-window.
-    bucket_warm_s = None
-    warm_incomplete = False
-    while time.monotonic() - t_warm < 600:
-        if eng.bucket_warm_failed.is_set():
-            log("e2e: WARNING bucket grid warm FAILED "
-                f"{time.monotonic() - t_warm:.0f}s after first "
-                "traffic; some keys will cold-compile mid-measurement")
-            break
-        if eng.bucket_warm_done.is_set():
-            bucket_warm_s = time.monotonic() - t_warm
-            log(f"e2e: bucket grid warm complete "
-                f"{bucket_warm_s:.0f}s after first traffic")
-            break
-        time.sleep(0.5)
-    else:
-        # Deadline hit with the warm still running: record how long it
-        # had been going when measurement started (a null here used to
-        # erase the fact that the warm consumed the whole budget —
-        # BENCH diag satellite, PR 13) and flag the measurement window
-        # as warm-contaminated.
-        bucket_warm_s = time.monotonic() - t_warm
-        warm_incomplete = True
-        log("e2e: WARNING bucket grid warm not done after 600s; "
-            "measuring anyway")
+    bucket_warm_s, warm_incomplete = wait_bucket_warm(eng, 600)
     time.sleep(warmup)
 
     def _shed_counts() -> dict[str, float]:

@@ -163,7 +163,7 @@ class DetectorBank:
         self._lock = threading.Lock()
         self.fired: list[Detection] = []  # last accepted firings
 
-    def observe(
+    def observe(  # hot-path: event
         self,
         epoch: int,
         records: np.ndarray | None,

@@ -275,7 +275,7 @@ class SketchEngine:
         # idle zeroing and latch a stale anomaly flag), or None to
         # shut the thread down.
         self._harvest_q: queue_mod.Queue = queue_mod.Queue()  # noqa: RT102 — window-cadence items, see above
-        self._harvest_thread: threading.Thread | None = None
+        self._harvest_thread: threading.Thread | None = None  # guarded-by: self._harvest_lock
         # Set by the shutdown path after the final drain: a straggler
         # (e.g. a warm_close racing stop) must not resurrect the
         # thread, or it would park on the queue forever pinning the
@@ -283,7 +283,7 @@ class SketchEngine:
         # straggler close checking the flag concurrently with shutdown
         # setting it could otherwise spawn a fresh thread that never
         # sees the None sentinel (already consumed) and parks forever.
-        self._harvest_retired = False
+        self._harvest_retired = False  # guarded-by: self._harvest_lock
         self._harvest_lock = threading.Lock()
         # Bumped by _restart_harvest when the watchdog replaces a hung
         # harvest thread: a superseded instance exits after finishing
@@ -869,6 +869,12 @@ class SketchEngine:
         jobs: list[tuple[Any, Callable, tuple]] = [
             ("window close", self._warm_close_job, ()),
         ]
+        if self._flow_dict is not None:
+            # Flow-dict dispatch needs the device descriptor table on
+            # its very first batch; building it here keeps even that
+            # zeros-jit compile off the event path (it also seeds the
+            # AOT disk cache entry a post-resync rebuild will hit).
+            jobs.append(("desc table", self._ensure_desc_table, ()))
         buckets = self._reachable_buckets()
         for i, b in enumerate(buckets):
             if self._flow_dict is not None:
@@ -994,11 +1000,11 @@ class SketchEngine:
         t.start()
         return t
 
-    def step_records(self, records: np.ndarray, now_s: int | None = None) -> None:
+    def step_records(self, records: np.ndarray, now_s: int | None = None) -> None:  # hot-path: event
         """Feed one host block synchronously (tests / direct callers)."""
         self._dispatch(records, now_s or int(time.time()))
 
-    def _dispatch(
+    def _dispatch(  # hot-path: event
         self, records: np.ndarray, now_s: int,
         record_metrics: bool = True,
     ) -> None:
@@ -1014,7 +1020,7 @@ class SketchEngine:
         self._dispatch_sharded(sb, now_s, n_raw=len(records),
                                record_metrics=record_metrics)
 
-    def _compile_cached(self, tag: str, key, lower):  # runs-on: device-proxy
+    def _compile_cached(self, tag: str, key, lower):  # runs-on: device-proxy # may-block: AOT disk-cache consult — the warm jobs prefill every reachable key at startup; a miss is once-per-shape and a <10s disk load beats a 100s+ recompile
         """Compile one per-bucket ingest executable, consulting the AOT
         disk cache first. ``lower`` is a thunk returning the
         ``jax.stages.Lowered``; on a miss its compiled executable is
@@ -1153,17 +1159,26 @@ class SketchEngine:
 
         return mk
 
-    def _ensure_desc_table(self):
+    def _ensure_desc_table(self):  # runs-on: device-proxy
         """(proxy thread) Device descriptor table, created by a zeros
-        jit ON device — never uploaded from host. The jit build runs
-        outside _fd_lock (it can cold-compile); only this proxy-thread
-        method CREATES the table, so a concurrent resync can at worst
-        clear the slot, and storing a freshly-zeroed table over that
-        clear is exactly the state a resync wants."""
+        jit ON device — never uploaded from host. The build runs
+        outside _fd_lock; only this proxy-thread method CREATES the
+        table, so a concurrent resync can at worst clear the slot, and
+        storing a freshly-zeroed table over that clear is exactly the
+        state a resync wants.
+
+        Routed through _compile_cached: _desc_table_fn builds a FRESH
+        jit closure per call, so every resync used to re-trace and
+        recompile the zeros program inline on the dispatch lane
+        (RT401) — the AOT disk cache turns that into a one-time cost,
+        and the desc-table background warm job (see _warm_jobs) moves
+        even the first touch off the event path."""
         with self._fd_lock:
             table = self._desc_table
         if table is None:
-            table = self._desc_table_fn()()
+            mk = self._desc_table_fn()
+            ex = self._compile_cached("desc_table", "zeros", mk.lower)
+            table = ex()
             with self._fd_lock:
                 self._desc_table = table
         return table
@@ -2152,7 +2167,7 @@ class SketchEngine:
         device-proxy thread, whatever thread calls this)."""
         run_on_device(self._close_window_impl)
 
-    def _close_window_impl(self) -> None:
+    def _close_window_impl(self) -> None:  # hot-path: close
         """(proxy thread) End the entropy/anomaly window. Runs as a
         fire-and-forget proxy submission from the dispatch worker, so it
         stays ordered after the step submissions that fed the window.
@@ -2278,7 +2293,7 @@ class SketchEngine:
         self._harvest_q.put(("win", stacked, meta))
         get_metrics().windows_closed.inc()
 
-    def _submit_close_window(self) -> None:
+    def _submit_close_window(self) -> None:  # hot-path: close
         """Fire-and-forget window close on the PROTECTED close lane:
         FIFO-ordered after step submissions on the proxy queue, but
         bounded by its own semaphore — a step pipeline that has eaten
@@ -2391,7 +2406,7 @@ class SketchEngine:
         bench diag."""
         return self._overload.stats()
 
-    def _build_quantum(  # runs-on: feed-worker*
+    def _build_quantum(  # runs-on: feed-worker*  # hot-path: event
         self, blocks: list[np.ndarray], n_raw: int, now_s: int
     ) -> list[tuple]:
         """Combine + partition one flush quantum into dispatchable step
@@ -2902,8 +2917,13 @@ class SketchEngine:
         from retina_tpu.checkpoint import save_state
 
         def save():
+            # Snapshot the reference only: state is replaced
+            # functionally (never mutated in place), so the file write
+            # — seconds of IO — must not hold _state_lock and convoy
+            # the dispatch/close lanes behind it (RT403).
             with self._state_lock:
-                save_state(path, self.state, self.pcfg)
+                state = self.state
+            save_state(path, state, self.pcfg)
 
         run_on_device(save)
 

@@ -218,7 +218,7 @@ class FleetAggregator:
             self._stop.wait(cadence)
 
     # -- ingest --------------------------------------------------------
-    def ingest(self, frame: bytes) -> bool:  # runs-on: pubsub*, grpc*
+    def ingest(self, frame: bytes) -> bool:  # runs-on: pubsub*, grpc*  # hot-path: transport
         """Decode + bucket one wire frame. Returns True when accepted."""
         m = get_metrics()
         try:
@@ -367,7 +367,7 @@ class FleetAggregator:
         self._merge_cache[key] = fn
         return fn
 
-    def _merge_epoch(
+    def _merge_epoch(  # may-block: device merge on the caller's thread — transport-lane reach is the sync cfg.fleet_merge_async=False mode (tests/bench); production fleets set it True and merge on the poll thread (windowed _ready_q handoff)
         self, epoch: int, bucket: _EpochBucket, straggled: bool
     ) -> None:
         t0 = time.monotonic()

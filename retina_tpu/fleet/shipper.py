@@ -127,7 +127,7 @@ class SnapshotShipper:
         self.seed_gen = int(gen)
 
     # -- close-path entry (device-proxy thread; must never block) ------
-    def offer(
+    def offer(  # hot-path: close
         self,
         epoch: int,
         arrays: dict[str, Any],

@@ -95,14 +95,14 @@ class LocalNodeClient:
         self.calls = 0
         self._cache: dict[Any, dict] = {}
 
-    def query(
+    def query(  # hot-path: query
         self, e0: int, e1: int, deadline_s: float
     ) -> dict[str, Any] | None:
         self.calls += 1
         if self.dead:
             return None
         if self.latency_s > 0:
-            time.sleep(self.latency_s)
+            time.sleep(self.latency_s)  # noqa: RT400 — simulated wire latency; LocalNodeClient is the in-process harness transport, 0.0 by default
         if self.dead:  # died while "on the wire"
             return None
         key = (int(e0), int(e1), self.ring.appended)
@@ -204,7 +204,7 @@ class FleetQueryService:
         }
 
     # -- HTTP entry (handler threads; must bound latency) --------------
-    def handle(self, q: dict) -> tuple[int, bytes, str]:
+    def handle(self, q: dict) -> tuple[int, bytes, str]:  # hot-path: query
         m = get_metrics()
         t0 = time.monotonic()
         status = "error"

@@ -201,7 +201,7 @@ class HubbleServer:
     def _list_peers(self, request: bytes, ctx) -> bytes:
         return _pack({"peers": self._peer_list()})
 
-    def _fleet_ship(self, request: bytes, ctx) -> bytes:
+    def _fleet_ship(self, request: bytes, ctx) -> bytes:  # hot-path: transport
         """Unary Ship: one RFLT frame in, {"ok": bool} out. Accepted
         means decoded + buffered (or merged); a False ok surfaces drop
         reasons the node side can count without parsing relay logs."""

@@ -81,10 +81,10 @@ class FlightRecorder:
             )
             self._local.ring = r
             with self._rings_lock:
-                self._rings.append(r)
+                self._rings.append(r)  # noqa: RT402 — one ring per producer thread, first call only; bounded by thread count, not event rate
         return r
 
-    def begin(self) -> float:
+    def begin(self) -> float:  # hot-path: event
         """Sampling gate + span start timestamp.
 
         Returns 0.0 when this span is sampled out (or the recorder is
@@ -98,7 +98,7 @@ class FlightRecorder:
             return 0.0
         return time.perf_counter()
 
-    def record(
+    def record(  # hot-path: event
         self,
         stage: str,
         t0: float,

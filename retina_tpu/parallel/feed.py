@@ -82,7 +82,7 @@ class TransferQueue:
             self.space.clear()
         if t0 is not None:
             self.wait_s += time.monotonic() - t0
-        self.q.append(item)
+        self.q.append(item)  # noqa: RT402 — bounded: the loop above spins until len(q) < depth; consumer poplefts via TransferMux.get
         self.data.set()
         return True
 
@@ -173,7 +173,7 @@ class FeedWorker(threading.Thread):
     def pending_events(self) -> int:
         return self.events_in - self.events_out
 
-    def push(self, block) -> None:
+    def push(self, block) -> None:  # hot-path: event
         if self.pending_events() == 0:
             self.first_t = time.monotonic()
         self.staging.append(block)
@@ -231,7 +231,7 @@ class FeedWorker(threading.Thread):
             if self.pool.deregister_hb is not None:
                 self.pool.deregister_hb(self.name)
 
-    def _loop(self, hb) -> None:
+    def _loop(self, hb) -> None:  # hot-path: event
         while True:
             stopping = self.pool.stop_evt.is_set()
             pend = self.pending_events()

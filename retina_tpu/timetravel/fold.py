@@ -64,7 +64,7 @@ def _args_sig(args: tuple) -> tuple:
     )
 
 
-def _disk_compiled(tag: str, jitted, args: tuple):
+def _disk_compiled(tag: str, jitted, args: tuple):  # may-block: AOT disk-cache consult, once per (program, signature) — _AOT_EXEC_CACHE serves every later call in-memory; a one-time ms-scale load on the query lane beats a seconds-scale recompile
     """Executable for one (program, concrete-args signature):
     in-memory first, then the shared AOT disk cache, else
     lower+compile+persist. Without a cache dir, the plain jitted fn

@@ -73,7 +73,7 @@ class QueryService:
         )
 
     # -- HTTP entry (handler threads; must bound latency) --------------
-    def handle(self, q: dict) -> tuple[int, bytes, str]:
+    def handle(self, q: dict) -> tuple[int, bytes, str]:  # hot-path: query
         m = get_metrics()
         t0 = time.monotonic()
         status = "error"

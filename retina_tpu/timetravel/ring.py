@@ -112,7 +112,7 @@ class SnapshotRing:
         self._thread = None
 
     # -- close-path entry (device-proxy thread; must never block) ------
-    def offer(
+    def offer(  # hot-path: close
         self,
         epoch: int,
         arrays: dict[str, Any],

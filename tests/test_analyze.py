@@ -26,6 +26,7 @@ from tools.analyze import (  # noqa: E402
     rt226,
     rt230,
     rt300,
+    rt400,
 )
 from tools.analyze.core import (  # noqa: E402
     FileCtx,
@@ -1031,3 +1032,323 @@ def test_device_pass_findings_are_baselinable(tmp_path, monkeypatch):
     out.clear()
     assert driver.run([], root=REPO, out=out.append) == 0
     assert any("1 baselined" in line for line in out)
+
+
+# ------------------------------------------------- RT400 hot-path
+
+def run_rt400(tmp_path, files: dict[str, str]):
+    """Program-rule runner: write the fixture tree, run rt400 over it."""
+    ctxs = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        ctxs.append(FileCtx(p, rel, p.read_text()))
+    rep = Reporter()
+    rt400.check_program(ctxs, rep, tmp_path)
+    return rep.findings
+
+
+HOT_CALLER = """
+    from retina_tpu.helper import stage
+
+    class Pump:
+        def drain(self):  # hot-path: event
+            stage()
+"""
+
+
+def test_rt400_cross_module_transitive_sleep(tmp_path):
+    # The blocking fact lives two modules away from the declared root;
+    # the finding lands AT the fact with the root chain in the message.
+    found = run_rt400(tmp_path, {
+        "retina_tpu/hot.py": HOT_CALLER,
+        "retina_tpu/helper.py": """
+            import time
+
+            def stage():
+                deeper()
+
+            def deeper():
+                time.sleep(0.5)
+        """,
+    })
+    assert codes(found) == ["RT400"]
+    f = found[0]
+    assert f.path == "retina_tpu/helper.py"
+    assert "Pump.drain" in f.message and "lane=event" in f.message
+    # stable key: survives line drift, usable from baseline.json
+    assert f.key == "RT400:retina_tpu/helper.py:deeper:sleep"
+
+
+def test_rt400_bounded_waits_do_not_fire(tmp_path):
+    # Bounded waits and _nowait are the sanctioned backpressure idiom;
+    # put on a provably unbounded queue never blocks (RT102's beat).
+    found = run_rt400(tmp_path, {
+        "retina_tpu/hot.py": """
+            import queue
+
+            class Pump:
+                def __init__(self):
+                    self.uq = queue.Queue()
+                    self.bq = queue.Queue(maxsize=4)
+
+                def drain(self, inq):  # hot-path: close
+                    self._space.wait(0.02)
+                    inq.get(timeout=1.0)
+                    self.uq.put(1)
+                    self.bq.put_nowait(2)
+        """,
+    })
+    assert found == []
+
+
+def test_rt400_bounded_queue_put_fires(tmp_path):
+    found = run_rt400(tmp_path, {
+        "retina_tpu/hot.py": """
+            import queue
+
+            class Pump:
+                def __init__(self):
+                    self.bq = queue.Queue(maxsize=4)
+
+                def drain(self):  # hot-path: close
+                    self.bq.put(1)
+        """,
+    })
+    assert codes(found) == ["RT400"]
+    assert "Queue.put" in found[0].message
+
+
+def test_rt400_may_block_hatch_stops_descent(tmp_path):
+    found = run_rt400(tmp_path, {
+        "retina_tpu/hot.py": HOT_CALLER,
+        "retina_tpu/helper.py": """
+            import time
+
+            def stage():  # may-block: reviewed — startup spill path, bounded by disk speed
+                time.sleep(0.5)
+        """,
+    })
+    assert found == []
+
+
+def test_rt400_empty_may_block_reason_is_malformed(tmp_path):
+    found = run_rt400(tmp_path, {
+        "retina_tpu/hot.py": """
+            import time
+
+            def stage():  # may-block:
+                time.sleep(0.5)
+        """,
+    })
+    assert codes(found) == ["RT400"]
+    assert "may-block" in found[0].message
+
+
+def test_rt400_noqa_at_site(tmp_path):
+    found = run_rt400(tmp_path, {
+        "retina_tpu/hot.py": HOT_CALLER,
+        "retina_tpu/helper.py": """
+            import time
+
+            def stage():
+                time.sleep(0.5)  # noqa: RT400 — harness-only simulated latency
+        """,
+    })
+    assert found == []
+
+
+def test_rt400_unknown_lane_is_malformed(tmp_path):
+    found = run_rt400(tmp_path, {
+        "retina_tpu/hot.py": """
+            def f():  # hot-path: turbo
+                pass
+        """,
+    })
+    assert codes(found) == ["RT400"]
+    assert "turbo" in found[0].message
+
+
+def test_rt401_cold_device_entry_call_fires(tmp_path):
+    src = """
+        import jax
+
+        def device_entry(name, kind=None):
+            def wrap(f):
+                return f
+            return wrap
+
+        class Eng:
+            @device_entry("eng.tbl", kind="jit")
+            def _tbl_fn(self):
+                return jax.jit(lambda a: a)
+
+            def hot(self):  # hot-path: event
+                self._tbl_fn()(1)
+    """
+    found = run_rt400(tmp_path, {"retina_tpu/eng.py": src})
+    assert codes(found) == ["RT401"]
+    assert "Eng._tbl_fn" in found[0].message
+    # jax.jit INSIDE the @device_entry builder is not double-reported:
+    # the call-site rule governs.
+    assert found[0].key == "RT401:retina_tpu/eng.py:Eng.hot:Eng._tbl_fn"
+
+
+def test_rt401_warm_marker_in_caller_satisfies(tmp_path):
+    # Disk-cache routing at the call site (fold.py idiom): the caller
+    # mentions _disk_compiled, so the builder call is warm-routed.
+    found = run_rt400(tmp_path, {
+        "retina_tpu/eng.py": """
+            import jax
+
+            def device_entry(name, kind=None):
+                def wrap(f):
+                    return f
+                return wrap
+
+            class Eng:
+                @device_entry("eng.tbl", kind="jit")
+                def _tbl_fn(self):
+                    return jax.jit(lambda a: a)
+
+                def hot(self):  # hot-path: event
+                    fn = self._tbl_fn()
+                    ex = _disk_compiled("tbl", fn, ())
+                    ex(1)
+        """,
+    })
+    assert found == []
+
+
+def test_rt401_bare_jit_dispatch_fires(tmp_path):
+    found = run_rt400(tmp_path, {
+        "retina_tpu/eng.py": """
+            import jax
+
+            def hot(x):  # hot-path: query
+                return jax.jit(lambda a: a + 1)(x)
+        """,
+    })
+    assert codes(found) == ["RT401"]
+    assert "bare jax.jit" in found[0].message
+
+
+def test_rt402_untrimmed_append_and_per_record_alloc(tmp_path):
+    found = run_rt400(tmp_path, {
+        "retina_tpu/bank.py": """
+            class Bank:
+                def __init__(self):
+                    self.rows = []
+
+                def tap(self, records):  # hot-path: event
+                    for r in records:
+                        self.rows.append({"k": r})
+        """,
+    })
+    got = codes(found)
+    assert got.count("RT402") == 2, found  # append + dict-in-loop
+    msgs = " ".join(f.message for f in found)
+    assert "rows" in msgs and "per-record loop" in msgs
+
+
+def test_rt402_trimmed_or_reset_containers_do_not_fire(tmp_path):
+    # A per-window reset (plain or annotated assign outside __init__)
+    # or an explicit trim bounds the container.
+    found = run_rt400(tmp_path, {
+        "retina_tpu/bank.py": """
+            class Bank:
+                def __init__(self):
+                    self.rows = []
+                    self.hist = []
+
+                def begin_window(self):
+                    self.rows: list = []
+
+                def tap(self, rec):  # hot-path: event
+                    self.rows.append(rec)
+                    self.hist.append(rec)
+                    del self.hist[:-16]
+        """,
+    })
+    assert found == []
+
+
+def test_rt402_only_on_event_lane(tmp_path):
+    # Window-rate (close lane) growth is not per-event growth.
+    found = run_rt400(tmp_path, {
+        "retina_tpu/bank.py": """
+            class Bank:
+                def __init__(self):
+                    self.rollups = []
+
+                def close(self, win):  # hot-path: close
+                    self.rollups.append(win)
+        """,
+    })
+    assert found == []
+
+
+def test_rt403_lock_convoy(tmp_path):
+    src = """
+        import time
+
+        class Svc:
+            def hot(self):  # hot-path: event
+                with self._lock:
+                    self.n = 1
+
+            def checkpoint(self):
+                with self._lock:
+                    time.sleep(5)
+    """
+    found = run_rt400(tmp_path, {"retina_tpu/svc.py": src})
+    got = [f for f in found if f.code == "RT403"]
+    assert len(got) == 1, found
+    assert "Svc.checkpoint" in got[0].message
+    assert "lock convoy" in got[0].message
+    # Witness fixed (blocking moved outside the lock): convoy gone.
+    fixed = run_rt400(tmp_path, {
+        "retina_tpu/svc.py": """
+            import time
+
+            class Svc:
+                def hot(self):  # hot-path: event
+                    with self._lock:
+                        self.n = 1
+
+                def checkpoint(self):
+                    with self._lock:
+                        snap = self.n
+                    time.sleep(5)
+        """,
+    })
+    assert [f for f in fixed if f.code == "RT403"] == []
+
+
+def test_rt400_with_open_is_file_io(tmp_path):
+    # ``with open(path) as f:`` — the context expression IS the fact.
+    found = run_rt400(tmp_path, {
+        "retina_tpu/hot.py": """
+            def spill(path):  # hot-path: transport
+                with open(path, "wb") as f:
+                    f.flush()
+        """,
+    })
+    assert codes(found) == ["RT400"]
+    assert "file IO" in found[0].message
+
+
+def test_rt400_structural_roots_resolve_on_real_tree():
+    """Every STRUCTURAL_ROOTS entry must still name a real function —
+    a rename would otherwise silently drop a whole lane's coverage."""
+    ctxs = driver.parse_all(driver.REPO_ROOT)
+    good = [c for c in ctxs if c.syntax_error is None]
+    prog = rt400.Program(good)
+    for rel_sfx, cls, meth, lane in rt400.STRUCTURAL_ROOTS:
+        qual = f"{cls}.{meth}" if cls else meth
+        assert lane in rt400.LANES, (rel_sfx, lane)
+        assert any(
+            rel.endswith(rel_sfx) and q == qual
+            for (rel, q) in prog.funcs
+        ), f"structural root no longer resolves: {rel_sfx}:{qual}"

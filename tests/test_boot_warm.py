@@ -152,3 +152,51 @@ def test_background_warm_stops_early_on_shutdown():
     # Done is NOT set on an aborted warm — nobody may conclude the grid
     # is resident.
     assert not eng.bucket_warm_done.is_set()
+
+
+def test_desc_table_warm_job_in_flow_dict_mode():
+    """Flow-dict dispatch needs the device descriptor table on its
+    very first batch; the background warm builds it right behind the
+    window-close program so the zeros-jit compile (and, post-resync,
+    the AOT disk-cache load) stays off the event path (RT401)."""
+    eng = SketchEngine(small_cfg(feed_coalesce_windows=2))
+    jobs = [k for k, _, _ in eng._warm_jobs()]
+    assert jobs[0] == "window close"
+    assert jobs[1] == "desc table", jobs[:3]
+    # Plain-wire mode has no flow dict and no desc table to warm.
+    cfg = small_cfg(feed_coalesce_windows=2)
+    cfg.wire_flow_dict = False
+    plain = [k for k, _, _ in SketchEngine(cfg)._warm_jobs()]
+    assert "desc table" not in plain
+
+
+def test_wait_bucket_warm_polls_both_terminal_events():
+    """bench.run_e2e's warm wait must react to bucket_warm_failed
+    immediately — a failed warm never sets bucket_warm_done, and
+    waiting on done alone burned the full 600s cap before measuring
+    (ISSUE 20 satellite; WaitWarm's contract)."""
+    import bench
+
+    class StubEngine:
+        def __init__(self):
+            self.bucket_warm_done = threading.Event()
+            self.bucket_warm_failed = threading.Event()
+
+    logs: list[str] = []
+    failed = StubEngine()
+    failed.bucket_warm_failed.set()
+    dt, incomplete = bench.wait_bucket_warm(
+        failed, 600, emit=logs.append, sleep_s=0.01)
+    assert dt is None and not incomplete
+    assert any("FAILED" in line for line in logs)
+
+    done = StubEngine()
+    done.bucket_warm_done.set()
+    dt, incomplete = bench.wait_bucket_warm(
+        done, 600, emit=logs.append, sleep_s=0.01)
+    assert dt is not None and dt < 5.0 and not incomplete
+
+    stuck = StubEngine()
+    dt, incomplete = bench.wait_bucket_warm(
+        stuck, 0.05, emit=logs.append, sleep_s=0.01)
+    assert incomplete and dt is not None and dt >= 0.05

@@ -69,5 +69,6 @@ def test_lint_runs_all_rule_families():
     )
     assert proc.returncode == 0
     for family in ("generic", "RT100", "RT101", "RT102", "RT200",
-                   "RT205", "RT210", "RT220", "RT230", "RT300"):
+                   "RT205", "RT210", "RT220", "RT230", "RT300",
+                   "RT400"):
         assert family in proc.stdout, f"missing family {family}"

@@ -22,6 +22,7 @@ from pathlib import Path
 
 from tools.analyze import (
     generic, rt10x, rt200, rt210, rt220, rt225, rt226, rt230, rt300,
+    rt400,
 )
 from tools.analyze.core import (
     FileCtx,
@@ -48,7 +49,7 @@ FILE_RULES = (
 )
 PROGRAM_RULES = (
     rt220.check_program, rt225.check_program, rt226.check_program,
-    rt230.check_program,
+    rt230.check_program, rt400.check_program,
 )
 
 RULE_FAMILIES = {
@@ -76,6 +77,11 @@ RULE_FAMILIES = {
     "RT205": "lock-acquisition order cycle (potential deadlock "
              "between threads taking the same locks in opposite "
              "order)",
+    "RT400": "hot-path reachability: blocking primitive reachable "
+             "from a hot-path root (+RT401 cold compile on the hot "
+             "path, RT402 unbounded per-event allocation, RT403 "
+             "lock convoy — hot lock held elsewhere across a "
+             "blocking call)",
     "RT300": "[--device] merge algebra uses a non-associative/"
              "commutative primitive, or registry/recipe inventory "
              "drift (+RT301 u32 counter can wrap in-window, RT302 "
